@@ -1,0 +1,26 @@
+"""predictionio_tpu: a TPU-native machine learning server.
+
+A brand-new framework with the capabilities of Apache PredictionIO
+(reference: remington-wpt/incubator-predictionio): an event-ingestion REST
+server with ``$set/$unset/$delete`` entity-property semantics, a DASE engine
+lifecycle (DataSource -> Preparator -> Algorithm(s) -> Serving, plus
+Evaluation), a ``pio``-style CLI, pluggable metadata/event/model storage, and
+a low-latency query server -- with the Spark/MLlib execution layer replaced
+by JAX/XLA on a TPU device mesh (pjit/shard_map + Pallas kernels).
+
+Layer map (mirrors SURVEY.md section 1; reference paths cited per-module):
+
+- ``predictionio_tpu.data``        -- L2 event model + L1 storage backends
+- ``predictionio_tpu.data.api``    -- L5 Event Server (REST ingestion)
+- ``predictionio_tpu.controller``  -- L3 DASE controller API
+- ``predictionio_tpu.workflow``    -- L4 train/eval/deploy lifecycle
+- ``predictionio_tpu.tools``       -- L6 CLI + ops tooling
+- ``predictionio_tpu.ops``         -- TPU compute kernels (segment/ragged/pallas)
+- ``predictionio_tpu.parallel``    -- mesh/sharding/collectives (replaces Spark L0)
+- ``predictionio_tpu.models``      -- engine templates (ALS, classification,
+                                      similar-product, universal recommender, NCF)
+"""
+
+from predictionio_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,17 @@
+"""Data layer: event model, property aggregation, storage registry, stores.
+
+Rebuilds the behavior of the reference's ``data/`` module
+(apache/predictionio layout: ``data/src/main/scala/org/apache/predictionio/data/``,
+unverified against /root/reference -- see SURVEY.md "Provenance warning").
+"""
+
+from predictionio_tpu.data.datamap import DataMap, PropertyMap, DataMapError
+from predictionio_tpu.data.event import Event, EventValidationError
+
+__all__ = [
+    "DataMap",
+    "PropertyMap",
+    "DataMapError",
+    "Event",
+    "EventValidationError",
+]

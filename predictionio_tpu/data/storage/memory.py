@@ -1,0 +1,17 @@
+"""In-memory storage backend (test/dev parity role of reference LocalFS+H2).
+
+Reuses the sqlite implementation over an in-memory database so behavior is
+identical to the persistent dev backend.
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.data.storage.base import StorageClientConfig
+from predictionio_tpu.data.storage.sqlite.client import StorageClient as _SQLiteClient
+
+
+class StorageClient(_SQLiteClient):
+    def __init__(self, config: StorageClientConfig):
+        config.properties = dict(config.properties)
+        config.properties["PATH"] = ":memory:"
+        super().__init__(config)

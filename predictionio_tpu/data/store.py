@@ -1,0 +1,212 @@
+"""Event store facades: the API engine templates actually call.
+
+Behavioral model: reference ``data/.../store/{LEventStore,PEventStore}.scala``
+(apache/predictionio layout, unverified -- SURVEY.md section 2.2 #12).
+
+- :class:`LEventStore`-style helpers: blocking, app-name-resolved queries for
+  serving-time lookups (``find_by_entity``).
+- :class:`PEventStore` analogue: where the reference returns ``RDD[Event]``,
+  we return an :class:`EventDataset` -- an in-memory columnar batch
+  (numpy arrays + string dictionaries) that feeds ``jax.device_put`` sharded
+  per mesh axis. This is the host-side batched reader of the north star.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import numpy as np
+
+from predictionio_tpu.data import storage as storage_registry
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+
+
+class AppNotFoundError(LookupError):
+    pass
+
+
+class ChannelNotFoundError(LookupError):
+    pass
+
+
+def resolve_app_channel(
+    app_name: str, channel_name: str | None = None
+) -> tuple[int, int | None]:
+    """appName (+channel) -> (appId, channelId), as LEventStore/Common does."""
+    apps = storage_registry.get_meta_data_apps()
+    app = apps.get_by_name(app_name)
+    if app is None:
+        raise AppNotFoundError(f"app {app_name!r} not found")
+    if channel_name is None:
+        return app.id, None
+    channels = storage_registry.get_meta_data_channels()
+    for ch in channels.get_by_app(app.id):
+        if ch.name == channel_name:
+            return app.id, ch.id
+    raise ChannelNotFoundError(f"channel {channel_name!r} not found in app {app_name!r}")
+
+
+@dataclass
+class EventDataset:
+    """Columnar view of an event query result.
+
+    String-valued columns are dictionary-encoded: ``entity_ids[i]`` indexes
+    into ``entity_id_vocab``. Numeric columns are dense numpy arrays, ready
+    to shard onto a device mesh. ``events`` retains the row objects for
+    host-side logic that needs full fidelity (properties etc.).
+    """
+
+    events: list[Event]
+    entity_id_vocab: list[str]
+    target_entity_id_vocab: list[str]
+    event_name_vocab: list[str]
+    entity_ids: np.ndarray        # int32 [n]
+    target_entity_ids: np.ndarray # int32 [n], -1 when absent
+    event_names: np.ndarray       # int32 [n]
+    event_times: np.ndarray       # float64 [n], epoch seconds
+    ratings: np.ndarray           # float32 [n], properties["rating"] or NaN
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    @classmethod
+    def from_events(cls, events: list[Event], rating_key: str = "rating") -> "EventDataset":
+        ent_vocab: dict[str, int] = {}
+        tgt_vocab: dict[str, int] = {}
+        name_vocab: dict[str, int] = {}
+        n = len(events)
+        ent = np.empty(n, dtype=np.int32)
+        tgt = np.full(n, -1, dtype=np.int32)
+        names = np.empty(n, dtype=np.int32)
+        times = np.empty(n, dtype=np.float64)
+        ratings = np.full(n, np.nan, dtype=np.float32)
+        for i, ev in enumerate(events):
+            ent[i] = ent_vocab.setdefault(ev.entity_id, len(ent_vocab))
+            if ev.target_entity_id is not None:
+                tgt[i] = tgt_vocab.setdefault(ev.target_entity_id, len(tgt_vocab))
+            names[i] = name_vocab.setdefault(ev.event, len(name_vocab))
+            times[i] = ev.event_time.timestamp()
+            r = ev.properties.get_opt(rating_key)
+            if isinstance(r, (int, float)) and not isinstance(r, bool):
+                ratings[i] = float(r)
+        return cls(
+            events=events,
+            entity_id_vocab=list(ent_vocab),
+            target_entity_id_vocab=list(tgt_vocab),
+            event_name_vocab=list(name_vocab),
+            entity_ids=ent,
+            target_entity_ids=tgt,
+            event_names=names,
+            event_times=times,
+            ratings=ratings,
+        )
+
+
+class LEventStore:
+    """Blocking serving-time event reads, resolved by app name."""
+
+    @staticmethod
+    def find(
+        app_name: str,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        channel_name: str | None = None,
+        event_names: list[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        limit: int | None = None,
+        latest: bool = True,
+    ) -> Iterator[Event]:
+        app_id, channel_id = resolve_app_channel(app_name, channel_name)
+        return storage_registry.get_l_events().find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit,
+            reversed=latest,
+        )
+
+    @staticmethod
+    def find_by_entity(
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: str | None = None,
+        **kwargs,
+    ) -> Iterator[Event]:
+        return LEventStore.find(
+            app_name,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            channel_name=channel_name,
+            **kwargs,
+        )
+
+
+class PEventStore:
+    """Training-time bulk reads -> columnar EventDataset (RDD replacement)."""
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: str | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: list[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+    ) -> list[Event]:
+        app_id, channel_id = resolve_app_channel(app_name, channel_name)
+        return list(
+            storage_registry.get_l_events().find(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+            )
+        )
+
+    @staticmethod
+    def dataset(
+        app_name: str, rating_key: str = "rating", **kwargs
+    ) -> EventDataset:
+        return EventDataset.from_events(
+            PEventStore.find(app_name, **kwargs), rating_key=rating_key
+        )
+
+    @staticmethod
+    def aggregate_properties(
+        app_name: str,
+        entity_type: str,
+        channel_name: str | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        required: list[str] | None = None,
+    ) -> dict[str, PropertyMap]:
+        app_id, channel_id = resolve_app_channel(app_name, channel_name)
+        return storage_registry.get_l_events().aggregate_properties(
+            app_id=app_id,
+            entity_type=entity_type,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )

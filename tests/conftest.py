@@ -1,0 +1,28 @@
+"""Test harness config.
+
+Multi-device semantics without hardware (SURVEY.md section 4 implication):
+force the JAX CPU backend with 8 virtual devices -- the ``local[*]`` analogue
+of the reference's Spark test fixtures. Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture()
+def storage_env(tmp_path, monkeypatch):
+    """Point the storage registry at a fresh sqlite file per test."""
+    from predictionio_tpu.data import storage as storage_registry
+
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    storage_registry.reset()
+    yield storage_registry
+    storage_registry.reset()

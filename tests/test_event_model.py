@@ -1,0 +1,151 @@
+"""Event model + DataMap + aggregation contract tests.
+
+Mirrors the reference's DataMapSpec / LEventAggregatorSpec scope
+(SURVEY.md section 4 tier 1/2)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, DataMapError, Event, EventValidationError
+from predictionio_tpu.data.aggregation import aggregate_entity, aggregate_properties
+
+UTC = dt.timezone.utc
+
+
+def ev(name, eid="e1", t=0, props=None, **kw):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=eid,
+        properties=DataMap(props or {}),
+        event_time=dt.datetime(2020, 1, 1, tzinfo=UTC) + dt.timedelta(seconds=t),
+        **kw,
+    )
+
+
+class TestDataMap:
+    def test_typed_getters(self):
+        d = DataMap({"a": 1, "b": "x", "c": 2.5, "d": True, "e": [1.0, 2], "f": ["u", "v"]})
+        assert d.get_int("a") == 1
+        assert d.get_string("b") == "x"
+        assert d.get_double("c") == 2.5
+        assert d.get_double("a") == 1.0  # int where double expected: OK (JSON numbers)
+        assert d.get_boolean("d") is True
+        assert d.get_double_list("e") == [1.0, 2.0]
+        assert d.get_string_list("f") == ["u", "v"]
+
+    def test_missing_and_wrong_type(self):
+        d = DataMap({"a": 1})
+        with pytest.raises(DataMapError):
+            d.get_string("missing")
+        with pytest.raises(DataMapError):
+            d.get_string("a")
+        with pytest.raises(DataMapError):
+            DataMap({"b": True}).get_int("b")  # bool is not an int here
+        assert d.get_opt("missing") is None
+        assert d.get_opt("missing", 7) == 7
+
+    def test_functional_updates(self):
+        d = DataMap({"a": 1, "b": 2})
+        assert d.updated({"b": 3, "c": 4}).to_dict() == {"a": 1, "b": 3, "c": 4}
+        assert d.removed(["a"]).to_dict() == {"b": 2}
+        assert d.to_dict() == {"a": 1, "b": 2}  # originals untouched
+
+
+class TestEventValidation:
+    def test_reserved_names(self):
+        with pytest.raises(EventValidationError):
+            ev("$rate")
+        with pytest.raises(EventValidationError):
+            ev("pio_internal")
+        with pytest.raises(EventValidationError):
+            Event(event="rate", entity_type="pio_user", entity_id="u1")
+        ev("$set", props={"a": 1})  # allowed
+
+    def test_unset_requires_properties(self):
+        with pytest.raises(EventValidationError):
+            ev("$unset")
+        ev("$unset", props={"a": None})
+
+    def test_special_events_reject_target(self):
+        with pytest.raises(EventValidationError):
+            Event(
+                event="$set",
+                entity_type="user",
+                entity_id="u1",
+                target_entity_type="item",
+                target_entity_id="i1",
+                properties=DataMap({"a": 1}),
+            )
+
+    def test_target_entity_pairing(self):
+        with pytest.raises(EventValidationError):
+            Event(event="view", entity_type="user", entity_id="u1", target_entity_type="item")
+
+    def test_json_round_trip(self):
+        obj = {
+            "event": "rate",
+            "entityType": "user",
+            "entityId": "u1",
+            "targetEntityType": "item",
+            "targetEntityId": "i9",
+            "properties": {"rating": 4.5},
+            "eventTime": "2020-06-01T12:30:00.000+00:00",
+            "prId": "pr-1",
+        }
+        e = Event.from_json_obj(obj)
+        out = e.to_json_obj()
+        for k in ("event", "entityType", "entityId", "targetEntityType", "targetEntityId", "prId"):
+            assert out[k] == obj[k]
+        assert out["properties"] == {"rating": 4.5}
+        assert out["eventTime"].startswith("2020-06-01T12:30:00")
+
+    def test_naive_event_time_becomes_utc(self):
+        e = Event.from_json_obj(
+            {"event": "a", "entityType": "u", "entityId": "1", "eventTime": "2020-01-01T00:00:00"}
+        )
+        assert e.event_time.tzinfo is not None
+
+
+class TestAggregation:
+    def test_set_merge_and_unset(self):
+        pm = aggregate_entity(
+            [
+                ev("$set", t=0, props={"a": 1, "b": 2}),
+                ev("$set", t=10, props={"b": 3, "c": 4}),
+                ev("$unset", t=20, props={"a": None}),
+            ]
+        )
+        assert pm.to_dict() == {"b": 3, "c": 4}
+        assert pm.first_updated == dt.datetime(2020, 1, 1, tzinfo=UTC)
+        assert pm.last_updated == dt.datetime(2020, 1, 1, 0, 0, 20, tzinfo=UTC)
+
+    def test_delete_clears_and_resets_window(self):
+        assert aggregate_entity([ev("$set", t=0, props={"a": 1}), ev("$delete", t=5)]) is None
+        pm = aggregate_entity(
+            [
+                ev("$set", t=0, props={"a": 1}),
+                ev("$delete", t=5),
+                ev("$set", t=10, props={"b": 2}),
+            ]
+        )
+        assert pm.to_dict() == {"b": 2}
+        assert pm.first_updated == dt.datetime(2020, 1, 1, 0, 0, 10, tzinfo=UTC)
+
+    def test_out_of_order_events_sorted_by_time(self):
+        pm = aggregate_entity(
+            [ev("$set", t=10, props={"a": 2}), ev("$set", t=0, props={"a": 1})]
+        )
+        assert pm.to_dict() == {"a": 2}
+
+    def test_multi_entity_and_never_set(self):
+        res = aggregate_properties(
+            [
+                ev("$set", eid="u1", t=0, props={"a": 1}),
+                ev("$set", eid="u2", t=0, props={"a": 2}),
+                ev("$delete", eid="u2", t=1),
+                ev("view", eid="u3", t=0),  # non-special: ignored
+            ]
+        )
+        assert set(res) == {"u1"}
