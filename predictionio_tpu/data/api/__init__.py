@@ -1,0 +1,1 @@
+"""L5 HTTP services for the data layer (Event Server)."""

@@ -1,0 +1,360 @@
+"""Event Server: REST ingestion into the append-only event store.
+
+Behavioral model: reference ``data/.../api/EventServer.scala`` (apache/
+predictionio layout, unverified -- SURVEY.md section 2.2 #15 and Appendix A).
+Wire contract kept:
+
+- ``POST /events.json?accessKey=K[&channel=ch]`` -> ``201 {"eventId": ...}``
+- ``GET  /events.json`` with filters (startTime/untilTime/entityType/entityId/
+  event/targetEntityType/targetEntityId/limit/reversed)
+- ``GET|DELETE /events/<id>.json``
+- ``POST /batch/events.json`` (<=50 per request, per-item status array)
+- ``GET  /stats.json`` (when ``--stats``)
+- ``POST /webhooks/<connector>.json`` (+ form variant), ``GET`` for status
+- auth via ``accessKey`` query param or ``Authorization`` header; per-key
+  event whitelists; channels resolved by name
+- plugin hook points: input blockers / input sniffers
+  (``EventServerPlugin`` parity role)
+
+Default port 7070.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from predictionio_tpu.data import storage as storage_registry
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    parse_event_time,
+)
+from predictionio_tpu.data.storage.base import AccessKey
+from predictionio_tpu.data import webhooks as webhook_registry
+from predictionio_tpu.utils.http import (
+    Request,
+    Response,
+    Router,
+    ServiceThread,
+    make_server,
+)
+
+DEFAULT_PORT = 7070
+
+
+class EventServerPlugin:
+    """Hook points mirroring the reference's EventServerPlugin contract.
+
+    ``input_blocker`` may raise :class:`PluginRejection` to reject an event;
+    ``input_sniffer`` observes accepted events.
+    """
+
+    def input_blocker(self, event: Event, app_id: int, channel_id: int | None) -> None:
+        pass
+
+    def input_sniffer(self, event: Event, app_id: int, channel_id: int | None) -> None:
+        pass
+
+
+class PluginRejection(Exception):
+    def __init__(self, message: str, status: int = 403):
+        super().__init__(message)
+        self.status = status
+
+
+@dataclass
+class _Stats:
+    """Per-app event counters since server start (reference Stats actor)."""
+
+    start_time: float = field(default_factory=time.time)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    # (app_id, event_name, status) -> count
+    counts: dict[tuple[int, str, int], int] = field(default_factory=dict)
+
+    def record(self, app_id: int, event_name: str, status: int) -> None:
+        with self.lock:
+            key = (app_id, event_name, status)
+            self.counts[key] = self.counts.get(key, 0) + 1
+
+    def to_json(self) -> dict[str, Any]:
+        with self.lock:
+            per_app: dict[int, list[dict[str, Any]]] = {}
+            for (app_id, name, status), count in sorted(self.counts.items()):
+                per_app.setdefault(app_id, []).append(
+                    {"event": name, "status": status, "count": count}
+                )
+        return {
+            "uptime": time.time() - self.start_time,
+            "appStatistics": [
+                {"appId": app_id, "events": events}
+                for app_id, events in per_app.items()
+            ],
+        }
+
+
+class EventService:
+    """Route handlers bound to the storage registry; server-framework free."""
+
+    def __init__(self, stats: bool = False, plugins: list[EventServerPlugin] | None = None):
+        self.stats_enabled = stats
+        self.stats = _Stats()
+        self.plugins = list(plugins or [])
+        self.router = Router()
+        r = self.router
+        r.add("GET", "/", self.handle_root)
+        r.add("POST", "/events.json", self.handle_create_event)
+        r.add("GET", "/events.json", self.handle_find_events)
+        r.add("GET", "/events/<event_id>.json", self.handle_get_event)
+        r.add("DELETE", "/events/<event_id>.json", self.handle_delete_event)
+        r.add("POST", "/batch/events.json", self.handle_batch)
+        r.add("GET", "/stats.json", self.handle_stats)
+        r.add("POST", "/webhooks/<connector>.json", self.handle_webhook_post)
+        r.add("GET", "/webhooks/<connector>.json", self.handle_webhook_get)
+
+    # -- auth ---------------------------------------------------------------
+    def _access_key(self, request: Request) -> str | None:
+        if "accessKey" in request.query:
+            return request.query["accessKey"]
+        auth = request.headers.get("Authorization", "")
+        # SDKs send the key as the basic-auth username with empty password
+        if auth.startswith("Basic "):
+            import base64
+
+            try:
+                decoded = base64.b64decode(auth[6:]).decode("utf-8")
+                return decoded.split(":", 1)[0]
+            except Exception:
+                return None
+        if auth.startswith("Bearer "):
+            return auth[7:]
+        return None
+
+    def _authorize(self, request: Request) -> tuple[AccessKey, int | None]:
+        """Return (access key record, channel id) or raise _AuthError."""
+        key = self._access_key(request)
+        if not key:
+            raise _AuthError(401, "missing accessKey")
+        record = storage_registry.get_meta_data_access_keys().get(key)
+        if record is None:
+            raise _AuthError(401, "invalid accessKey")
+        channel_id = None
+        channel_name = request.query.get("channel")
+        if channel_name:
+            channels = storage_registry.get_meta_data_channels().get_by_app(
+                record.app_id
+            )
+            match = [c for c in channels if c.name == channel_name]
+            if not match:
+                raise _AuthError(400, f"invalid channel {channel_name!r}")
+            channel_id = match[0].id
+        return record, channel_id
+
+    def _check_event_allowed(self, record: AccessKey, event_name: str) -> None:
+        if record.events and event_name not in record.events:
+            raise _AuthError(
+                403, f"accessKey is not allowed to write event {event_name!r}"
+            )
+
+    # -- handlers -----------------------------------------------------------
+    def handle_root(self, request: Request) -> Response:
+        return Response(200, {"status": "alive"})
+
+    def _insert_one(
+        self, obj: Any, record: AccessKey, channel_id: int | None
+    ) -> tuple[int, dict[str, Any]]:
+        try:
+            if isinstance(obj, dict):
+                # creationTime is server-assigned on the ingest path; a client
+                # (unlike pio import) may not spoof it
+                obj = {k: v for k, v in obj.items() if k != "creationTime"}
+            event = Event.from_json_obj(obj)
+            self._check_event_allowed(record, event.event)
+            for plugin in self.plugins:
+                plugin.input_blocker(event, record.app_id, channel_id)
+            event_id = storage_registry.get_l_events().insert(
+                event, record.app_id, channel_id
+            )
+            for plugin in self.plugins:
+                plugin.input_sniffer(event, record.app_id, channel_id)
+            if self.stats_enabled:
+                self.stats.record(record.app_id, event.event, 201)
+            return 201, {"eventId": event_id}
+        except EventValidationError as exc:
+            if self.stats_enabled:
+                name = obj.get("event", "<invalid>") if isinstance(obj, dict) else "<invalid>"
+                self.stats.record(record.app_id, str(name), 400)
+            return 400, {"message": str(exc)}
+        except _AuthError as exc:
+            return exc.status, {"message": str(exc)}
+        except PluginRejection as exc:
+            return exc.status, {"message": str(exc)}
+
+    def handle_create_event(self, request: Request) -> Response:
+        try:
+            record, channel_id = self._authorize(request)
+        except _AuthError as exc:
+            return Response(exc.status, {"message": str(exc)})
+        try:
+            obj = request.json()
+        except json.JSONDecodeError:
+            return Response(400, {"message": "malformed JSON body"})
+        status, body = self._insert_one(obj, record, channel_id)
+        return Response(status, body)
+
+    def handle_batch(self, request: Request) -> Response:
+        try:
+            record, channel_id = self._authorize(request)
+        except _AuthError as exc:
+            return Response(exc.status, {"message": str(exc)})
+        try:
+            objs = request.json()
+        except json.JSONDecodeError:
+            return Response(400, {"message": "malformed JSON body"})
+        if not isinstance(objs, list):
+            return Response(400, {"message": "request body must be a JSON array"})
+        if len(objs) > 50:
+            return Response(
+                400, {"message": "batch size must be <= 50 events per request"}
+            )
+        results = []
+        for obj in objs:
+            status, body = self._insert_one(obj, record, channel_id)
+            results.append({"status": status, **body})
+        return Response(200, results)
+
+    def handle_get_event(self, request: Request) -> Response:
+        try:
+            record, channel_id = self._authorize(request)
+        except _AuthError as exc:
+            return Response(exc.status, {"message": str(exc)})
+        event = storage_registry.get_l_events().get(
+            request.path_params["event_id"], record.app_id, channel_id
+        )
+        if event is None:
+            return Response(404, {"message": "event not found"})
+        return Response(200, event.to_json_obj())
+
+    def handle_delete_event(self, request: Request) -> Response:
+        try:
+            record, channel_id = self._authorize(request)
+        except _AuthError as exc:
+            return Response(exc.status, {"message": str(exc)})
+        found = storage_registry.get_l_events().delete(
+            request.path_params["event_id"], record.app_id, channel_id
+        )
+        if not found:
+            return Response(404, {"message": "event not found"})
+        return Response(200, {"message": "deleted"})
+
+    def handle_find_events(self, request: Request) -> Response:
+        try:
+            record, channel_id = self._authorize(request)
+        except _AuthError as exc:
+            return Response(exc.status, {"message": str(exc)})
+        q = request.query
+        try:
+            start_time = parse_event_time(q["startTime"]) if "startTime" in q else None
+            until_time = parse_event_time(q["untilTime"]) if "untilTime" in q else None
+        except EventValidationError as exc:
+            return Response(400, {"message": str(exc)})
+        limit = None
+        if "limit" in q:
+            try:
+                limit = int(q["limit"])
+            except ValueError:
+                return Response(400, {"message": "limit must be an integer"})
+        event_names = q["event"].split(",") if "event" in q else None
+        kwargs: dict[str, Any] = {}
+        if "targetEntityType" in q:
+            kwargs["target_entity_type"] = q["targetEntityType"]
+        if "targetEntityId" in q:
+            kwargs["target_entity_id"] = q["targetEntityId"]
+        events = storage_registry.get_l_events().find(
+            app_id=record.app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=q.get("entityType"),
+            entity_id=q.get("entityId"),
+            event_names=event_names,
+            limit=limit if limit is not None else 20,
+            reversed=q.get("reversed", "false").lower() == "true",
+            **kwargs,
+        )
+        return Response(200, [e.to_json_obj() for e in events])
+
+    def handle_stats(self, request: Request) -> Response:
+        if not self.stats_enabled:
+            return Response(
+                404, {"message": "stats not enabled (start server with --stats)"}
+            )
+        return Response(200, self.stats.to_json())
+
+    # -- webhooks -----------------------------------------------------------
+    def handle_webhook_post(self, request: Request) -> Response:
+        try:
+            record, channel_id = self._authorize(request)
+        except _AuthError as exc:
+            return Response(exc.status, {"message": str(exc)})
+        name = request.path_params["connector"]
+        content_type = request.headers.get("Content-Type", "")
+        try:
+            if "application/x-www-form-urlencoded" in content_type:
+                connector = webhook_registry.FORM_CONNECTORS.get(name)
+                if connector is None:
+                    return Response(404, {"message": f"unknown form connector {name!r}"})
+                event = connector.to_event(request.form())
+            else:
+                connector = webhook_registry.JSON_CONNECTORS.get(name)
+                if connector is None:
+                    return Response(404, {"message": f"unknown connector {name!r}"})
+                payload = request.json()
+                if not isinstance(payload, dict):
+                    return Response(400, {"message": "webhook body must be a JSON object"})
+                event = connector.to_event(payload)
+        except webhook_registry.ConnectorError as exc:
+            return Response(400, {"message": str(exc)})
+        except json.JSONDecodeError:
+            return Response(400, {"message": "malformed JSON body"})
+        status, body = self._insert_one(event.to_json_obj(), record, channel_id)
+        return Response(status, body)
+
+    def handle_webhook_get(self, request: Request) -> Response:
+        name = request.path_params["connector"]
+        known = name in webhook_registry.JSON_CONNECTORS or name in webhook_registry.FORM_CONNECTORS
+        if not known:
+            return Response(404, {"message": f"unknown connector {name!r}"})
+        return Response(200, {"connector": name, "status": "ready"})
+
+
+class _AuthError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+def create_event_server(
+    host: str = "0.0.0.0",
+    port: int = DEFAULT_PORT,
+    stats: bool = False,
+    plugins: list[EventServerPlugin] | None = None,
+) -> ServiceThread:
+    service = EventService(stats=stats, plugins=plugins)
+    server = make_server(service.router, host, port, "pio-eventserver")
+    return ServiceThread(server)
+
+
+def run_event_server(host: str = "0.0.0.0", port: int = DEFAULT_PORT, stats: bool = False) -> None:
+    """Blocking entry point used by ``pio eventserver``."""
+    service = EventService(stats=stats)
+    server = make_server(service.router, host, port, "pio-eventserver")
+    print(f"Event Server listening on http://{host}:{port} (stats={'on' if stats else 'off'})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.server_close()

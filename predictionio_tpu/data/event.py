@@ -87,6 +87,16 @@ class Event:
     creation_time: _dt.datetime = field(default_factory=_utcnow)
 
     def __post_init__(self):
+        # normalize naive datetimes to UTC so mixed-source events compare/sort
+        # and serialize consistently (frozen dataclass: use object.__setattr__)
+        if self.event_time.tzinfo is None:
+            object.__setattr__(
+                self, "event_time", self.event_time.replace(tzinfo=_dt.timezone.utc)
+            )
+        if self.creation_time.tzinfo is None:
+            object.__setattr__(
+                self, "creation_time", self.creation_time.replace(tzinfo=_dt.timezone.utc)
+            )
         validate_event_name(self.event)
         validate_entity("entityType", self.entity_type)
         validate_entity("entityId", self.entity_id)
@@ -135,6 +145,11 @@ class Event:
             event_time=event_time,
             event_id=obj.get("eventId"),
             pr_id=obj.get("prId"),
+            **(
+                {"creation_time": parse_event_time(obj["creationTime"])}
+                if obj.get("creationTime")
+                else {}
+            ),
         )
 
     def to_json_obj(self) -> dict[str, Any]:

@@ -150,6 +150,11 @@ def reset() -> None:
     _registry.reset()
 
 
+#: property keys safe to echo in `pio status` output; anything else
+#: (passwords, tokens, connection strings) is redacted
+_SAFE_PROPERTY_KEYS = {"PATH", "HOSTS", "PORTS", "HOST", "PORT", "SCHEMES", "INDEX"}
+
+
 def config_summary() -> dict[str, dict[str, str]]:
     """Resolved repository->source->type mapping (for ``pio status``)."""
     out = {}
@@ -159,7 +164,10 @@ def config_summary() -> dict[str, dict[str, str]]:
         out[repo] = {
             "source": source,
             "type": type_name,
-            **{k.lower(): v for k, v in cfg.properties.items()},
+            **{
+                k.lower(): (v if k in _SAFE_PROPERTY_KEYS else "<redacted>")
+                for k, v in cfg.properties.items()
+            },
         }
     return out
 

@@ -36,8 +36,15 @@ class LocalFSModels(base.Models):
         self.base_path = base_path
 
     def _path(self, model_id: str) -> Path:
-        # model ids are uuid hex / engine-instance ids; keep paths flat + safe
-        safe = "".join(c for c in model_id if c.isalnum() or c in "-_.")
+        # reversible encoding: distinct ids must never collide onto one file
+        # ids starting with "x" always take the encoded branch so a literal id
+        # can never collide with another id's hex encoding
+        if not model_id.startswith("x") and all(
+            c.isalnum() or c in "-_" for c in model_id
+        ):
+            safe = model_id
+        else:
+            safe = "x" + model_id.encode("utf-8").hex()
         return self.base_path / f"pio_model_{safe}.bin"
 
     def insert(self, model: Model) -> None:

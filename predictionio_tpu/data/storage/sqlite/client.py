@@ -106,7 +106,12 @@ _DEFAULT_CHANNEL = 0
 
 
 def _ts_to_str(ts: _dt.datetime | None) -> str | None:
-    return ts.isoformat() if ts else None
+    # normalize to UTC with fixed precision so text ORDER BY is chronological
+    if ts is None:
+        return None
+    if ts.tzinfo is None:
+        ts = ts.replace(tzinfo=_dt.timezone.utc)
+    return ts.astimezone(_dt.timezone.utc).isoformat(timespec="microseconds")
 
 
 def _ts_from_str(s: str | None) -> _dt.datetime | None:
@@ -114,6 +119,10 @@ def _ts_from_str(s: str | None) -> _dt.datetime | None:
 
 
 def _ts_ms(ts: _dt.datetime) -> int:
+    # same naive-means-UTC rule as Event.__post_init__, so stored values and
+    # find() bounds agree on any host timezone
+    if ts.tzinfo is None:
+        ts = ts.replace(tzinfo=_dt.timezone.utc)
     return int(ts.timestamp() * 1000)
 
 
@@ -123,6 +132,7 @@ class StorageClient(base.BaseStorageClient):
     def __init__(self, config: StorageClientConfig):
         super().__init__(config)
         path = config.properties.get("PATH", ":memory:")
+        self._path = path
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
@@ -152,6 +162,28 @@ class StorageClient(base.BaseStorageClient):
     def query(self, sql: str, params: tuple = ()) -> list[tuple]:
         with self._lock:
             return self._conn.execute(sql, params).fetchall()
+
+    def query_iter(self, sql: str, params: tuple = ()):
+        """Stream rows without blocking writers.
+
+        Opens a dedicated read connection (WAL mode gives it a consistent
+        snapshot independent of concurrent writes on the shared connection).
+        An in-memory database is private to its connection, so there we fall
+        back to a single locked fetchall.
+        """
+        if self._path == ":memory:":
+            yield from self.query(sql, params)
+            return
+        conn = sqlite3.connect(self._path, check_same_thread=False)
+        try:
+            cursor = conn.execute(sql, params)
+            while True:
+                rows = cursor.fetchmany(1024)
+                if not rows:
+                    return
+                yield from rows
+        finally:
+            conn.close()
 
     def close(self) -> None:
         with self._lock:
@@ -631,5 +663,5 @@ class SQLiteLEvents(base.LEvents):
         if limit is not None and limit >= 0:
             sql.append("LIMIT ?")
             params.append(limit)
-        for r in self.c.query(" ".join(sql), tuple(params)):
+        for r in self.c.query_iter(" ".join(sql), tuple(params)):
             yield self._row_to_event(r)

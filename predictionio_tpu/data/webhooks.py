@@ -1,0 +1,115 @@
+"""Webhook connectors: map third-party payloads to Events.
+
+Behavioral model: reference ``data/.../webhooks/{ConnectorUtil,JsonConnector,
+FormConnector}.scala`` + segmentio/mailchimp connectors (apache/predictionio
+layout, unverified -- SURVEY.md section 2.2 #14). Pluggable registry keyed by
+the URL path segment under ``/webhooks/``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Mapping
+
+from predictionio_tpu.data.event import Event, EventValidationError
+
+
+class ConnectorError(ValueError):
+    pass
+
+
+class JsonConnector(abc.ABC):
+    """Maps a JSON webhook payload to an Event."""
+
+    @abc.abstractmethod
+    def to_event_json(self, payload: Mapping[str, Any]) -> Mapping[str, Any]: ...
+
+    def to_event(self, payload: Mapping[str, Any]) -> Event:
+        try:
+            return Event.from_json_obj(self.to_event_json(payload))
+        except EventValidationError as exc:
+            raise ConnectorError(str(exc)) from exc
+
+
+class FormConnector(abc.ABC):
+    """Maps form-encoded webhook fields to an Event."""
+
+    @abc.abstractmethod
+    def to_event_json(self, form: Mapping[str, str]) -> Mapping[str, Any]: ...
+
+    def to_event(self, form: Mapping[str, str]) -> Event:
+        try:
+            return Event.from_json_obj(self.to_event_json(form))
+        except EventValidationError as exc:
+            raise ConnectorError(str(exc)) from exc
+
+
+class ExampleJsonConnector(JsonConnector):
+    """Reference-style example connector (exampleJson parity role)."""
+
+    def to_event_json(self, payload):
+        for field in ("type", "userId"):
+            if field not in payload:
+                raise ConnectorError(f"webhook payload missing {field!r}")
+        return {
+            "event": payload["type"],
+            "entityType": "user",
+            "entityId": str(payload["userId"]),
+            "properties": payload.get("properties", {}),
+            **({"eventTime": payload["timestamp"]} if "timestamp" in payload else {}),
+        }
+
+
+class SegmentIOConnector(JsonConnector):
+    """segment.com track-call mapping (SegmentIOConnector parity role)."""
+
+    def to_event_json(self, payload):
+        if payload.get("type") != "track":
+            raise ConnectorError("segmentio connector only accepts 'track' calls")
+        user = payload.get("userId") or payload.get("anonymousId")
+        if not user:
+            raise ConnectorError("segmentio payload has no userId/anonymousId")
+        if not payload.get("event"):
+            raise ConnectorError("segmentio payload missing 'event'")
+        out = {
+            "event": payload["event"],
+            "entityType": "user",
+            "entityId": str(user),
+            "properties": payload.get("properties", {}),
+        }
+        if payload.get("timestamp"):
+            out["eventTime"] = payload["timestamp"]
+        return out
+
+
+class ExampleFormConnector(FormConnector):
+    def to_event_json(self, form):
+        for field in ("type", "userId"):
+            if field not in form:
+                raise ConnectorError(f"webhook form missing {field!r}")
+        return {
+            "event": form["type"],
+            "entityType": "user",
+            "entityId": form["userId"],
+            "properties": {
+                k: v for k, v in form.items() if k not in ("type", "userId")
+            },
+        }
+
+
+#: path segment under /webhooks/ -> connector instance
+JSON_CONNECTORS: dict[str, JsonConnector] = {
+    "example": ExampleJsonConnector(),
+    "segmentio": SegmentIOConnector(),
+}
+FORM_CONNECTORS: dict[str, FormConnector] = {
+    "exampleform": ExampleFormConnector(),
+}
+
+
+def register_json_connector(name: str, connector: JsonConnector) -> None:
+    JSON_CONNECTORS[name] = connector
+
+
+def register_form_connector(name: str, connector: FormConnector) -> None:
+    FORM_CONNECTORS[name] = connector

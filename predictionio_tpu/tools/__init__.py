@@ -1,0 +1,1 @@
+"""L6 CLI + ops tooling (``pio`` verbs, import/export, dashboard, admin)."""

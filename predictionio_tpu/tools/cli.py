@@ -1,0 +1,69 @@
+"""The ``pio`` command-line console.
+
+Behavioral model: reference ``tools/.../console/{Console,Pio}.scala``
+(apache/predictionio layout, unverified -- SURVEY.md section 2.4 #27). Verb
+set and flag names kept; process orchestration targets the JAX runtime
+instead of spark-submit.
+
+This module grows with the framework; verbs are registered in
+``predictionio_tpu.tools.commands``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from predictionio_tpu.version import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pio",
+        description="predictionio_tpu: TPU-native machine learning server",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    sub.add_parser("version", help="print version")
+
+    status = sub.add_parser("status", help="verify configuration and storage connectivity")
+    status.set_defaults(func=cmd_status)
+
+    from predictionio_tpu.tools import commands
+
+    commands.register(sub)
+    return parser
+
+
+def cmd_status(args: argparse.Namespace) -> int:
+    from predictionio_tpu.data import storage
+
+    print(f"pio (predictionio_tpu) {__version__}")
+    print("Storage configuration:")
+    for repo, cfg in storage.config_summary().items():
+        detail = ", ".join(f"{k}={v}" for k, v in cfg.items() if k not in ("source",))
+        print(f"  {repo}: source={cfg['source']} ({detail})")
+    failures = storage.verify_all_data_objects()
+    if failures:
+        print("Storage check FAILED:")
+        for failure in failures:
+            print(f"  - {failure}")
+        return 1
+    print("Storage check OK. Your system is all ready to go.")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.command is None:
+        parser.print_help()
+        return 2
+    if args.command == "version":
+        print(__version__)
+        return 0
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

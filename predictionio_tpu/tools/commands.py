@@ -1,0 +1,16 @@
+"""Registration point for ``pio`` subcommands.
+
+App/accesskey/train/deploy/eval/import/export verbs attach here as their
+subsystems land (SURVEY.md section 2.4 #27 lists the full reference verb set).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    from predictionio_tpu.tools import app_commands, server_commands
+
+    app_commands.register(sub)
+    server_commands.register(sub)

@@ -1,0 +1,20 @@
+"""``pio eventserver`` (and later dashboard/adminserver) verbs."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    es = sub.add_parser("eventserver", help="start the Event Server")
+    es.add_argument("--ip", default="0.0.0.0")
+    es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--stats", action="store_true", help="enable /stats.json")
+    es.set_defaults(func=cmd_eventserver)
+
+
+def cmd_eventserver(args: argparse.Namespace) -> int:
+    from predictionio_tpu.data.api.eventserver import run_event_server
+
+    run_event_server(host=args.ip, port=args.port, stats=args.stats)
+    return 0

@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: logging config, small HTTP server toolkit."""

@@ -1,0 +1,162 @@
+"""Minimal threaded HTTP service toolkit over the standard library.
+
+The reference serves REST with akka-http actors (SURVEY.md section 2.2 #15,
+#25); here a ``ThreadingHTTPServer`` + route table plays that role -- no
+external web framework is required. CORS and JSON envelopes are handled
+centrally so every service (event server, query server, dashboard, admin)
+shares behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict[str, str]
+    headers: dict[str, str]
+    body: bytes
+    path_params: dict[str, str]
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+    def form(self) -> dict[str, str]:
+        parsed = parse_qs(self.body.decode("utf-8"), keep_blank_values=True)
+        return {k: v[0] for k, v in parsed.items()}
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: Any = None
+    content_type: str = "application/json; charset=utf-8"
+
+    def payload(self) -> bytes:
+        if self.body is None:
+            return b""
+        if isinstance(self.body, bytes):
+            return self.body
+        if isinstance(self.body, str):
+            return self.body.encode("utf-8")
+        return json.dumps(self.body).encode("utf-8")
+
+
+Handler = Callable[[Request], Response]
+
+
+class Router:
+    """Route table: (method, path regex with <name> captures) -> handler."""
+
+    def __init__(self):
+        self._routes: list[tuple[str, re.Pattern, Handler]] = []
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        regex = re.sub(r"<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", pattern)
+        self._routes.append((method.upper(), re.compile(f"^{regex}$"), handler))
+
+    def route(self, method: str, pattern: str):
+        def deco(fn: Handler) -> Handler:
+            self.add(method, pattern, fn)
+            return fn
+
+        return deco
+
+    def dispatch(self, request: Request) -> Response:
+        path_matched = False
+        for method, regex, handler in self._routes:
+            m = regex.match(request.path)
+            if not m:
+                continue
+            path_matched = True
+            if method != request.method:
+                continue
+            request.path_params = m.groupdict()
+            return handler(request)
+        if path_matched:
+            return Response(405, {"message": "method not allowed"})
+        return Response(404, {"message": "not found"})
+
+
+_CORS_HEADERS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "GET, POST, DELETE, OPTIONS",
+    "Access-Control-Allow-Headers": "Content-Type, Authorization",
+}
+
+
+def make_server(router: Router, host: str, port: int, server_name: str) -> ThreadingHTTPServer:
+    class _RequestHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = server_name
+
+        def log_message(self, fmt, *args):  # quiet by default; services log themselves
+            pass
+
+        def _handle(self):
+            parsed = urlparse(self.path)
+            query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            request = Request(
+                method=self.command,
+                path=parsed.path,
+                query=query,
+                headers={k: v for k, v in self.headers.items()},
+                body=body,
+                path_params={},
+            )
+            if self.command == "OPTIONS":
+                response = Response(200, "")
+            else:
+                try:
+                    response = router.dispatch(request)
+                except json.JSONDecodeError:
+                    response = Response(400, {"message": "malformed JSON body"})
+                except Exception:
+                    traceback.print_exc()
+                    response = Response(500, {"message": "internal server error"})
+            payload = response.payload()
+            self.send_response(response.status)
+            self.send_header("Content-Type", response.content_type)
+            self.send_header("Content-Length", str(len(payload)))
+            for k, v in _CORS_HEADERS.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = do_DELETE = do_PUT = do_OPTIONS = _handle
+
+    return ThreadingHTTPServer((host, port), _RequestHandler)
+
+
+class ServiceThread:
+    """Run an HTTP server on a daemon thread (tests / embedded use)."""
+
+    def __init__(self, server: ThreadingHTTPServer):
+        self.server = server
+        self._thread = threading.Thread(target=server.serve_forever, daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    def start(self) -> "ServiceThread":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,57 @@
+"""CLI verb tests (reference Console scope, SURVEY.md section 2.4)."""
+
+from predictionio_tpu.tools.cli import main
+
+
+def run(capsys, *argv):
+    code = main(list(argv))
+    return code, capsys.readouterr().out
+
+
+class TestAppVerbs:
+    def test_app_lifecycle(self, storage_env, capsys):
+        code, out = run(capsys, "app", "new", "Shop")
+        assert code == 0
+        assert "Access Key:" in out and "ID: 1" in out
+
+        code, out = run(capsys, "app", "new", "Shop")
+        assert code == 1  # duplicate
+
+        code, out = run(capsys, "app", "list")
+        assert "Shop" in out
+
+        code, out = run(capsys, "app", "show", "Shop")
+        assert "Name: Shop" in out
+
+        code, out = run(capsys, "app", "delete", "Shop", "--force")
+        assert code == 0
+        code, out = run(capsys, "app", "list")
+        assert "Shop" not in out
+
+    def test_channels(self, storage_env, capsys):
+        run(capsys, "app", "new", "A")
+        code, out = run(capsys, "app", "channel-new", "A", "backtest")
+        assert code == 0
+        code, out = run(capsys, "app", "channel-new", "A", "bad name")
+        assert code == 1
+        code, out = run(capsys, "app", "show", "A")
+        assert "Channel: backtest" in out
+        code, out = run(capsys, "app", "channel-delete", "A", "backtest", "--force")
+        assert code == 0
+
+    def test_accesskeys(self, storage_env, capsys):
+        run(capsys, "app", "new", "A")
+        code, out = run(capsys, "accesskey", "new", "A", "view", "buy")
+        assert code == 0
+        key = out.strip().split()[-1]
+        code, out = run(capsys, "accesskey", "list", "A")
+        assert key in out and "view, buy" in out
+        code, out = run(capsys, "accesskey", "delete", key)
+        assert code == 0
+
+    def test_status_and_version(self, storage_env, capsys):
+        code, out = run(capsys, "status")
+        assert code == 0
+        assert "ready to go" in out
+        code, out = run(capsys, "version")
+        assert code == 0

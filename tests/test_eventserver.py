@@ -1,0 +1,249 @@
+"""Event Server REST contract tests (reference EventServiceSpec scope,
+SURVEY.md section 4 tier 2 + Appendix A), driven over a live socket."""
+
+import json
+
+import pytest
+import requests
+
+from predictionio_tpu.data.api.eventserver import (
+    EventServerPlugin,
+    PluginRejection,
+    create_event_server,
+)
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+
+
+@pytest.fixture()
+def server(storage_env):
+    apps = storage_env.get_meta_data_apps()
+    app_id = apps.insert(App(name="TestApp"))
+    storage_env.get_meta_data_channels().insert(Channel(name="backtest", app_id=app_id))
+    key = storage_env.get_meta_data_access_keys().insert(AccessKey(key="", app_id=app_id))
+    storage_env.get_l_events().init_channel(app_id)
+    svc = create_event_server(host="127.0.0.1", port=0, stats=True).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    yield base, key
+    svc.stop()
+
+
+VALID = {"event": "rate", "entityType": "user", "entityId": "u1",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": 4}}
+
+
+class TestIngestion:
+    def test_create_and_get_event(self, server):
+        base, key = server
+        r = requests.post(f"{base}/events.json", params={"accessKey": key}, json=VALID)
+        assert r.status_code == 201
+        eid = r.json()["eventId"]
+        got = requests.get(f"{base}/events/{eid}.json", params={"accessKey": key})
+        assert got.status_code == 200
+        assert got.json()["event"] == "rate"
+        assert got.json()["properties"] == {"rating": 4}
+
+        # delete then 404
+        assert requests.delete(f"{base}/events/{eid}.json", params={"accessKey": key}).status_code == 200
+        assert requests.get(f"{base}/events/{eid}.json", params={"accessKey": key}).status_code == 404
+
+    def test_auth_failures(self, server):
+        base, key = server
+        assert requests.post(f"{base}/events.json", json=VALID).status_code == 401
+        assert requests.post(
+            f"{base}/events.json", params={"accessKey": "wrong"}, json=VALID
+        ).status_code == 401
+        # key via basic auth username works
+        r = requests.post(f"{base}/events.json", auth=(key, ""), json=VALID)
+        assert r.status_code == 201
+
+    def test_invalid_event_400(self, server):
+        base, key = server
+        r = requests.post(
+            f"{base}/events.json", params={"accessKey": key},
+            json={"event": "$bogus", "entityType": "user", "entityId": "u1"},
+        )
+        assert r.status_code == 400
+        r2 = requests.post(
+            f"{base}/events.json", params={"accessKey": key},
+            data="not json", headers={"Content-Type": "application/json"},
+        )
+        assert r2.status_code == 400
+
+    def test_batch_contract(self, server):
+        base, key = server
+        batch = [VALID, {"event": "$bad", "entityType": "u", "entityId": "1"}, VALID]
+        r = requests.post(f"{base}/batch/events.json", params={"accessKey": key}, json=batch)
+        assert r.status_code == 200
+        results = r.json()
+        assert [x["status"] for x in results] == [201, 400, 201]
+        assert "eventId" in results[0] and "message" in results[1]
+        # oversized batch rejected
+        r = requests.post(
+            f"{base}/batch/events.json", params={"accessKey": key}, json=[VALID] * 51
+        )
+        assert r.status_code == 400
+        # malformed envelope
+        r = requests.post(
+            f"{base}/batch/events.json", params={"accessKey": key}, json={"not": "array"}
+        )
+        assert r.status_code == 400
+
+    def test_channel_isolation_and_invalid_channel(self, server):
+        base, key = server
+        r = requests.post(
+            f"{base}/events.json", params={"accessKey": key, "channel": "backtest"},
+            json=VALID,
+        )
+        assert r.status_code == 201
+        # default channel does not see it
+        r = requests.get(f"{base}/events.json", params={"accessKey": key})
+        assert r.json() == []
+        r = requests.get(f"{base}/events.json", params={"accessKey": key, "channel": "backtest"})
+        assert len(r.json()) == 1
+        r = requests.post(
+            f"{base}/events.json", params={"accessKey": key, "channel": "nope"}, json=VALID
+        )
+        assert r.status_code == 400
+
+
+class TestQueryAndStats:
+    def test_find_filters(self, server):
+        base, key = server
+        events = [
+            {"event": "view", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item", "targetEntityId": "i1",
+             "eventTime": "2022-01-01T00:00:00Z"},
+            {"event": "buy", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item", "targetEntityId": "i2",
+             "eventTime": "2022-01-02T00:00:00Z"},
+            {"event": "view", "entityType": "user", "entityId": "u2",
+             "targetEntityType": "item", "targetEntityId": "i1",
+             "eventTime": "2022-01-03T00:00:00Z"},
+        ]
+        requests.post(f"{base}/batch/events.json", params={"accessKey": key}, json=events)
+        q = lambda **p: requests.get(
+            f"{base}/events.json", params={"accessKey": key, **p}
+        ).json()
+        assert len(q()) == 3
+        assert len(q(event="view")) == 2
+        assert len(q(entityId="u1")) == 2
+        assert len(q(targetEntityId="i1")) == 2
+        assert len(q(startTime="2022-01-02T00:00:00Z")) == 2
+        assert len(q(untilTime="2022-01-02T00:00:00Z")) == 1
+        assert len(q(limit="1")) == 1
+        rev = q(reversed="true")
+        assert rev[0]["event"] == "view" and rev[0]["entityId"] == "u2"
+        assert requests.get(
+            f"{base}/events.json", params={"accessKey": key, "limit": "zz"}
+        ).status_code == 400
+
+    def test_stats(self, server):
+        base, key = server
+        requests.post(f"{base}/events.json", params={"accessKey": key}, json=VALID)
+        requests.post(
+            f"{base}/events.json", params={"accessKey": key},
+            json={"event": "$bad", "entityType": "u", "entityId": "1"},
+        )
+        stats = requests.get(f"{base}/stats.json").json()
+        assert stats["uptime"] > 0
+        events = stats["appStatistics"][0]["events"]
+        assert {"event": "rate", "status": 201, "count": 1} in events
+        assert any(e["status"] == 400 for e in events)
+
+
+class TestWhitelistAndPlugins:
+    def test_event_whitelist(self, storage_env):
+        apps = storage_env.get_meta_data_apps()
+        app_id = apps.insert(App(name="WL"))
+        keys = storage_env.get_meta_data_access_keys()
+        key = keys.insert(AccessKey(key="", app_id=app_id, events=["view"]))
+        storage_env.get_l_events().init_channel(app_id)
+        svc = create_event_server(host="127.0.0.1", port=0).start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            ok = requests.post(
+                f"{base}/events.json", params={"accessKey": key},
+                json={"event": "view", "entityType": "user", "entityId": "u"},
+            )
+            assert ok.status_code == 201
+            denied = requests.post(
+                f"{base}/events.json", params={"accessKey": key},
+                json={"event": "buy", "entityType": "user", "entityId": "u"},
+            )
+            assert denied.status_code == 403
+        finally:
+            svc.stop()
+
+    def test_input_blocker_and_sniffer(self, storage_env):
+        apps = storage_env.get_meta_data_apps()
+        app_id = apps.insert(App(name="PL"))
+        key = storage_env.get_meta_data_access_keys().insert(AccessKey(key="", app_id=app_id))
+        storage_env.get_l_events().init_channel(app_id)
+        seen = []
+
+        class Blocker(EventServerPlugin):
+            def input_blocker(self, event, app_id, channel_id):
+                if event.entity_id == "blocked":
+                    raise PluginRejection("entity is blocked")
+
+            def input_sniffer(self, event, app_id, channel_id):
+                seen.append(event.entity_id)
+
+        svc = create_event_server(host="127.0.0.1", port=0, plugins=[Blocker()]).start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            ok = requests.post(
+                f"{base}/events.json", params={"accessKey": key},
+                json={"event": "view", "entityType": "user", "entityId": "fine"},
+            )
+            assert ok.status_code == 201
+            blocked = requests.post(
+                f"{base}/events.json", params={"accessKey": key},
+                json={"event": "view", "entityType": "user", "entityId": "blocked"},
+            )
+            assert blocked.status_code == 403
+            assert seen == ["fine"]
+        finally:
+            svc.stop()
+
+
+class TestWebhooks:
+    def test_json_webhook(self, server):
+        base, key = server
+        r = requests.post(
+            f"{base}/webhooks/example.json", params={"accessKey": key},
+            json={"type": "signup", "userId": 42, "properties": {"plan": "pro"}},
+        )
+        assert r.status_code == 201
+        found = requests.get(
+            f"{base}/events.json", params={"accessKey": key, "event": "signup"}
+        ).json()
+        assert found[0]["entityId"] == "42"
+        assert found[0]["properties"] == {"plan": "pro"}
+
+    def test_segmentio_webhook(self, server):
+        base, key = server
+        r = requests.post(
+            f"{base}/webhooks/segmentio.json", params={"accessKey": key},
+            json={"type": "track", "userId": "u9", "event": "Clicked",
+                  "properties": {"btn": 1}, "timestamp": "2023-01-01T00:00:00Z"},
+        )
+        assert r.status_code == 201
+        bad = requests.post(
+            f"{base}/webhooks/segmentio.json", params={"accessKey": key},
+            json={"type": "identify", "userId": "u9"},
+        )
+        assert bad.status_code == 400
+
+    def test_form_webhook_and_unknown(self, server):
+        base, key = server
+        r = requests.post(
+            f"{base}/webhooks/exampleform.json", params={"accessKey": key},
+            data={"type": "click", "userId": "u1", "page": "home"},
+        )
+        assert r.status_code == 201
+        assert requests.get(f"{base}/webhooks/example.json", params={"accessKey": key}).status_code == 200
+        assert requests.post(
+            f"{base}/webhooks/nosuch.json", params={"accessKey": key}, json={}
+        ).status_code == 404
