@@ -1,0 +1,66 @@
+"""L3 Controller API: the DASE abstractions engine templates implement.
+
+Behavioral model: reference ``core/.../controller/`` (apache/predictionio
+layout, unverified -- SURVEY.md section 2.3). The DASE lifecycle and its
+contracts are kept; the Spark-specific split (PAlgorithm/P2LAlgorithm/
+LAlgorithm over RDDs) collapses into a single :class:`TPUAlgorithm` whose
+``train`` receives a :class:`~predictionio_tpu.workflow.context.RuntimeContext`
+carrying the JAX device mesh -- the TPU-native replacement for SparkContext
+(BASELINE.json north star).
+"""
+
+from predictionio_tpu.controller.base import (
+    Algorithm,
+    DataSource,
+    EmptyParams,
+    EngineFactory,
+    EvalInfo,
+    IdentityPreparator,
+    Params,
+    PersistentModel,
+    Preparator,
+    SanityCheck,
+    Serving,
+    TPUAlgorithm,
+)
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.controller.serving import AverageServing, FirstServing
+from predictionio_tpu.controller.metrics import (
+    AverageMetric,
+    Evaluation,
+    EngineParamsGenerator,
+    Metric,
+    MetricEvaluator,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+
+__all__ = [
+    "Algorithm",
+    "AverageMetric",
+    "AverageServing",
+    "DataSource",
+    "EmptyParams",
+    "Engine",
+    "EngineFactory",
+    "EngineParams",
+    "EngineParamsGenerator",
+    "EvalInfo",
+    "Evaluation",
+    "FirstServing",
+    "IdentityPreparator",
+    "Metric",
+    "MetricEvaluator",
+    "OptionAverageMetric",
+    "Params",
+    "PersistentModel",
+    "Preparator",
+    "SanityCheck",
+    "Serving",
+    "StdevMetric",
+    "SumMetric",
+    "TPUAlgorithm",
+    "ZeroMetric",
+]

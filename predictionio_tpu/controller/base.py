@@ -1,0 +1,185 @@
+"""DASE component base classes.
+
+Behavioral model: reference ``core/.../core/Base*.scala`` +
+``core/.../controller/{PDataSource,LDataSource,PPreparator,LPreparator,
+PAlgorithm,P2LAlgorithm,LAlgorithm,LServing,Params,SanityCheck,
+PersistentModel}.scala`` (apache/predictionio layout, unverified --
+SURVEY.md section 2.3 #16-#22).
+
+Key redesign decisions (TPU-first, not a translation):
+
+- One ``DataSource``/``Preparator``/``Algorithm``/``Serving`` hierarchy
+  instead of the P/L/P2L triplets: the P-vs-L split existed to pick RDD vs
+  driver-local execution; here data is columnar on the host and compute is
+  jitted on the mesh, so the split is meaningless. ``TPUAlgorithm`` is the
+  ``PAlgorithm``-analogue whose ``train`` is expected to run pjit'd
+  computations over the context's mesh.
+- ``Params`` are plain dicts by convention (engine.json JSON objects),
+  wrapped in an attribute-access helper. No reflection-based Doer
+  construction: components are constructed with their params directly.
+- Model persistence matrix (reference Engine.prepareDeploy semantics,
+  SURVEY.md section 3.2): a model implementing :class:`PersistentModel`
+  saves/loads itself; otherwise the model is pickled into the Models blob
+  store; an algorithm declaring ``persist_model = False`` is retrained on
+  deploy.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Generic, Iterable, Mapping, Sequence, TypeVar
+
+
+class Params(dict):
+    """Engine-component parameters: a dict with attribute access.
+
+    Mirrors the role of the reference ``Params`` marker trait while staying
+    JSON-native (engine.json fragments deserialize straight into it).
+    """
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def get_or(self, name: str, default: Any) -> Any:
+        return self.get(name, default)
+
+
+class EmptyParams(Params):
+    pass
+
+
+TD = TypeVar("TD")  # TrainingData
+PD = TypeVar("PD")  # PreparedData
+Q = TypeVar("Q")    # Query
+P = TypeVar("P")    # PredictedResult
+A = TypeVar("A")    # ActualResult
+M = TypeVar("M")    # Model
+
+
+class EvalInfo(Params):
+    """Per-fold metadata returned by ``DataSource.read_eval``."""
+
+
+class Component:
+    """Shared construction: every DASE component takes its params dict."""
+
+    def __init__(self, params: Mapping[str, Any] | None = None):
+        self.params = params if isinstance(params, Params) else Params(params or {})
+
+
+class SanityCheck(abc.ABC):
+    """Optional post-stage hook (reference SanityCheck trait): raise to abort."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None: ...
+
+
+class DataSource(Component, Generic[TD, Q, A]):
+    """Reads TrainingData from the event store.
+
+    ``read_training`` is the train path; ``read_eval`` yields
+    ``(training_data, eval_info, [(query, actual)])`` folds for evaluation
+    (reference PDataSource.readTraining/readEval).
+    """
+
+    @abc.abstractmethod
+    def read_training(self, ctx) -> TD: ...
+
+    def read_eval(self, ctx) -> list[tuple[TD, EvalInfo, list[tuple[Q, A]]]]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement read_eval; "
+            "evaluation is unavailable for this engine"
+        )
+
+
+class Preparator(Component, Generic[TD, PD]):
+    @abc.abstractmethod
+    def prepare(self, ctx, training_data: TD) -> PD: ...
+
+
+class IdentityPreparator(Preparator):
+    """Pass-through preparator (reference IdentityPreparator)."""
+
+    def prepare(self, ctx, training_data):
+        return training_data
+
+
+class Algorithm(Component, Generic[PD, M, Q, P]):
+    """Algorithm contract: train on prepared data, answer queries.
+
+    ``persist_model = False`` opts into retrain-on-deploy (the reference's
+    PAlgorithm-without-persistence path).
+    """
+
+    persist_model: bool = True
+
+    @abc.abstractmethod
+    def train(self, ctx, prepared_data: PD) -> M: ...
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> P: ...
+
+    def batch_predict(self, model: M, queries: Sequence[tuple[int, Q]]) -> list[tuple[int, P]]:
+        """Default: loop predict. Override with a vectorized/jitted version."""
+        return [(qid, self.predict(model, q)) for qid, q in queries]
+
+    # -- query/result wire serde (CustomQuerySerializer parity role) --------
+    def query_from_json(self, obj: Any) -> Q:
+        """Deserialize a /queries.json body. Default: pass the dict through."""
+        return obj
+
+    def result_to_json(self, prediction: P) -> Any:
+        """Serialize a prediction for the wire. Default: JSON-able as-is,
+        with dataclass support."""
+        import dataclasses
+
+        if dataclasses.is_dataclass(prediction) and not isinstance(prediction, type):
+            return dataclasses.asdict(prediction)
+        return prediction
+
+
+class TPUAlgorithm(Algorithm[PD, M, Q, P]):
+    """Marker base for algorithms whose train() runs on the device mesh.
+
+    The workflow guarantees ``ctx.mesh`` is populated before ``train`` is
+    called (mesh of 1 on CPU dev machines; ICI mesh on a pod). This is the
+    BASELINE.json "TPUAlgorithm base whose train() is a pjit'd function over
+    an ICI mesh".
+    """
+
+
+class Serving(Component, Generic[Q, P]):
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[P]) -> P: ...
+
+
+class PersistentModel(abc.ABC):
+    """User-managed model persistence (reference PersistentModel[+Loader]).
+
+    ``save`` returns True if the model was persisted; returning False falls
+    back to the pickled-blob path. ``load`` is a classmethod resolved on
+    deploy.
+    """
+
+    @abc.abstractmethod
+    def save(self, instance_id: str, params: Params) -> bool: ...
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, instance_id: str, params: Params) -> "PersistentModel": ...
+
+
+class EngineFactory:
+    """Engines are built by factory callables named in engine.json
+    (``engineFactory``); subclassing this class is optional sugar."""
+
+    def apply(self):  # pragma: no cover - template-defined
+        raise NotImplementedError
+
+
+def component_name(obj: Any) -> str:
+    cls = obj if isinstance(obj, type) else type(obj)
+    return f"{cls.__module__}.{cls.__qualname__}"

@@ -1,0 +1,228 @@
+"""Engine: the central class tying DASE components + params together.
+
+Behavioral model: reference ``core/.../controller/Engine.scala`` +
+``EngineParams.scala`` (apache/predictionio layout, unverified -- SURVEY.md
+section 2.3 #17, section 3.1/3.2 call stacks). Responsibilities kept:
+
+- ``train(ctx, engine_params)``: D -> P -> per-algorithm train -> models
+- ``eval(ctx, engine_params)``: k-fold read_eval -> train -> batch predict
+  -> (query, prediction, actual) triples per fold
+- ``prepare_deploy(ctx, engine_params, instance_id)``: model rehydration
+  matrix (PersistentModel load | blob unpickle | retrain-on-deploy)
+- serialization of models into the Models blob store
+
+The class-registry role of EngineFactory reflection is played by dotted-path
+resolution in ``predictionio_tpu.workflow.json_extractor``.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence, Type
+
+from predictionio_tpu.controller.base import (
+    Algorithm,
+    DataSource,
+    Params,
+    PersistentModel,
+    Preparator,
+    SanityCheck,
+    Serving,
+    component_name,
+)
+from predictionio_tpu.controller.serving import FirstServing
+
+logger = logging.getLogger("pio.engine")
+
+
+@dataclass
+class EngineParams:
+    """Deserialized engine.json parameter block (reference EngineParams)."""
+
+    data_source_params: Params = field(default_factory=Params)
+    preparator_params: Params = field(default_factory=Params)
+    algorithm_params_list: list[tuple[str, Params]] = field(default_factory=list)
+    serving_params: Params = field(default_factory=Params)
+
+    @classmethod
+    def from_json_obj(cls, obj: Mapping[str, Any]) -> "EngineParams":
+        algorithms = [
+            (a.get("name", "default"), Params(a.get("params", {})))
+            for a in obj.get("algorithms", [{"name": "default", "params": {}}])
+        ]
+        return cls(
+            data_source_params=Params(obj.get("datasource", {}).get("params", {})),
+            preparator_params=Params(obj.get("preparator", {}).get("params", {})),
+            algorithm_params_list=algorithms,
+            serving_params=Params(obj.get("serving", {}).get("params", {})),
+        )
+
+    def to_json_obj(self) -> dict[str, Any]:
+        return {
+            "datasource": {"params": dict(self.data_source_params)},
+            "preparator": {"params": dict(self.preparator_params)},
+            "algorithms": [
+                {"name": name, "params": dict(params)}
+                for name, params in self.algorithm_params_list
+            ],
+            "serving": {"params": dict(self.serving_params)},
+        }
+
+
+class Engine:
+    """Binds DASE component classes; instantiates them per run with params."""
+
+    def __init__(
+        self,
+        data_source_class: Type[DataSource],
+        preparator_class: Type[Preparator],
+        algorithm_class_map: Mapping[str, Type[Algorithm]],
+        serving_class: Type[Serving] = FirstServing,
+    ):
+        self.data_source_class = data_source_class
+        self.preparator_class = preparator_class
+        self.algorithm_class_map = dict(algorithm_class_map)
+        self.serving_class = serving_class
+
+    # -- construction helpers ----------------------------------------------
+    def _algorithms(self, engine_params: EngineParams) -> list[Algorithm]:
+        algorithms = []
+        for name, params in engine_params.algorithm_params_list:
+            if name not in self.algorithm_class_map:
+                raise KeyError(
+                    f"algorithm {name!r} not registered in engine"
+                    f" (available: {sorted(self.algorithm_class_map)})"
+                )
+            algorithms.append(self.algorithm_class_map[name](params))
+        if not algorithms:
+            raise ValueError("engine_params names no algorithms")
+        return algorithms
+
+    def serving(self, engine_params: EngineParams) -> Serving:
+        return self.serving_class(engine_params.serving_params)
+
+    @staticmethod
+    def _maybe_sanity_check(stage: str, obj: Any, skip: bool) -> None:
+        if not skip and isinstance(obj, SanityCheck):
+            logger.info("sanity check: %s", stage)
+            obj.sanity_check()
+
+    # -- train --------------------------------------------------------------
+    def train(
+        self,
+        ctx,
+        engine_params: EngineParams,
+        skip_sanity_check: bool = False,
+    ) -> list[Any]:
+        data_source = self.data_source_class(engine_params.data_source_params)
+        training_data = data_source.read_training(ctx)
+        self._maybe_sanity_check("training data", training_data, skip_sanity_check)
+
+        preparator = self.preparator_class(engine_params.preparator_params)
+        prepared_data = preparator.prepare(ctx, training_data)
+        self._maybe_sanity_check("prepared data", prepared_data, skip_sanity_check)
+
+        models = []
+        for algorithm, (name, _) in zip(
+            self._algorithms(engine_params), engine_params.algorithm_params_list
+        ):
+            logger.info("training algorithm %r (%s)", name, component_name(algorithm))
+            model = algorithm.train(ctx, prepared_data)
+            self._maybe_sanity_check(f"model[{name}]", model, skip_sanity_check)
+            models.append(model)
+        return models
+
+    # -- serialization + deploy rehydration ---------------------------------
+    def serialize_models(
+        self, ctx, engine_params: EngineParams, instance_id: str, models: Sequence[Any]
+    ) -> bytes:
+        """Encode the per-algorithm persistence choice into one blob."""
+        entries = []
+        for model, algorithm, (name, params) in zip(
+            models, self._algorithms(engine_params), engine_params.algorithm_params_list
+        ):
+            if isinstance(model, PersistentModel):
+                if model.save(instance_id, params):
+                    entries.append(("persistent", component_name(model)))
+                    continue
+            if not algorithm.persist_model:
+                entries.append(("retrain", None))
+                continue
+            buf = io.BytesIO()
+            pickle.dump(model, buf, protocol=pickle.HIGHEST_PROTOCOL)
+            entries.append(("pickle", buf.getvalue()))
+        return pickle.dumps(entries, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def prepare_deploy(
+        self,
+        ctx,
+        engine_params: EngineParams,
+        instance_id: str,
+        model_blob: bytes | None,
+    ) -> list[Any]:
+        """Rehydrate per-algorithm models for serving (reference semantics:
+        PersistentModelLoader -> load; pickled blob -> deserialize;
+        persist_model=False -> retrain now)."""
+        algorithms = self._algorithms(engine_params)
+        entries = pickle.loads(model_blob) if model_blob else [("retrain", None)] * len(
+            algorithms
+        )
+        if len(entries) != len(algorithms):
+            raise ValueError(
+                f"model blob has {len(entries)} entries but engine_params names"
+                f" {len(algorithms)} algorithms -- retrain required"
+            )
+        retrained: list[Any] | None = None
+        models = []
+        for i, (entry, algorithm, (name, params)) in enumerate(
+            zip(entries, algorithms, engine_params.algorithm_params_list)
+        ):
+            kind, payload = entry
+            if kind == "persistent":
+                model_cls = _resolve_class(payload)
+                models.append(model_cls.load(instance_id, params))
+            elif kind == "pickle":
+                models.append(pickle.loads(payload))
+            elif kind == "retrain":
+                if retrained is None:
+                    logger.info("retrain-on-deploy: running engine.train")
+                    retrained = self.train(ctx, engine_params, skip_sanity_check=True)
+                models.append(retrained[i])
+            else:  # pragma: no cover - corrupted blob
+                raise ValueError(f"unknown model persistence kind {kind!r}")
+        return models
+
+    # -- eval ---------------------------------------------------------------
+    def eval(
+        self, ctx, engine_params: EngineParams
+    ) -> list[tuple[Any, list[tuple[Any, Any, Any]]]]:
+        """Run evaluation folds.
+
+        Returns ``[(eval_info, [(query, prediction, actual), ...]), ...]``.
+        """
+        data_source = self.data_source_class(engine_params.data_source_params)
+        preparator = self.preparator_class(engine_params.preparator_params)
+        serving = self.serving(engine_params)
+        folds = data_source.read_eval(ctx)
+        results = []
+        for training_data, eval_info, qa_pairs in folds:
+            prepared_data = preparator.prepare(ctx, training_data)
+            algorithms = self._algorithms(engine_params)
+            models = [a.train(ctx, prepared_data) for a in algorithms]
+            indexed = list(enumerate(q for q, _ in qa_pairs))
+            per_algo = [dict(a.batch_predict(m, indexed)) for a, m in zip(algorithms, models)]
+            triples = []
+            for qid, (query, actual) in enumerate(qa_pairs):
+                predictions = [pa[qid] for pa in per_algo]
+                triples.append((query, serving.serve(query, predictions), actual))
+            results.append((eval_info, triples))
+        return results
+
+
+def _resolve_class(dotted: str):
+    from predictionio_tpu.workflow.json_extractor import resolve_dotted
+
+    return resolve_dotted(dotted)

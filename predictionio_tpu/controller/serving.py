@@ -1,0 +1,41 @@
+"""Stock serving combinators (reference FirstServing/AverageServing,
+SURVEY.md section 2.3 #20)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from predictionio_tpu.controller.base import Serving
+
+
+class FirstServing(Serving):
+    """Return the first algorithm's prediction."""
+
+    def serve(self, query, predictions: Sequence):
+        if not predictions:
+            raise ValueError("FirstServing received no predictions")
+        return predictions[0]
+
+
+class AverageServing(Serving):
+    """Average numeric predictions across algorithms.
+
+    Works on plain numbers or on dicts with a numeric field per key.
+    """
+
+    def serve(self, query, predictions: Sequence):
+        if not predictions:
+            raise ValueError("AverageServing received no predictions")
+        first = predictions[0]
+        if isinstance(first, (int, float)):
+            return sum(predictions) / len(predictions)
+        if isinstance(first, dict):
+            keys = set(first)
+            out = {}
+            for k in keys:
+                values = [p[k] for p in predictions if isinstance(p.get(k), (int, float))]
+                out[k] = sum(values) / len(values) if values else first[k]
+            return out
+        raise TypeError(
+            f"AverageServing cannot average predictions of type {type(first).__name__}"
+        )

@@ -42,10 +42,20 @@ def validate_event_name(name: str) -> None:
         _require(not name.startswith("pio_"), f"event name {name!r}: prefix 'pio_' is reserved")
 
 
+#: reserved entity types the framework itself writes (feedback loop)
+INTERNAL_ENTITY_TYPES = frozenset({"pio_pr"})
+
+
 def validate_entity(kind: str, value: str) -> None:
     _require(isinstance(value, str), f"{kind} must be a string, got {type(value).__name__}")
     _require(bool(value), f"{kind} must not be empty")
-    _require(not value.startswith("pio_"), f"{kind} {value!r}: prefix 'pio_' is reserved")
+    # the pio_pr exemption is for entity *types* (feedback loop); ids keep the
+    # full reserved-prefix rule
+    exempt = kind in ("entityType", "targetEntityType") and value in INTERNAL_ENTITY_TYPES
+    _require(
+        not value.startswith("pio_") or exempt,
+        f"{kind} {value!r}: prefix 'pio_' is reserved",
+    )
 
 
 def parse_event_time(value: str) -> _dt.datetime:

@@ -10,7 +10,8 @@ import argparse
 
 
 def register(sub: argparse._SubParsersAction) -> None:
-    from predictionio_tpu.tools import app_commands, server_commands
+    from predictionio_tpu.tools import app_commands, engine_commands, server_commands
 
     app_commands.register(sub)
+    engine_commands.register(sub)
     server_commands.register(sub)

@@ -1,0 +1,12 @@
+"""L4 Workflow: train/eval/deploy lifecycle around the controller API.
+
+Behavioral model: reference ``core/.../workflow/`` (apache/predictionio
+layout, unverified -- SURVEY.md section 2.3 #24-#26). ``WorkflowContext``'s
+SparkContext construction is replaced by :class:`RuntimeContext` carrying a
+JAX device mesh.
+"""
+
+from predictionio_tpu.workflow.context import RuntimeContext, WorkflowParams
+from predictionio_tpu.workflow.core_workflow import run_train, run_evaluation
+
+__all__ = ["RuntimeContext", "WorkflowParams", "run_train", "run_evaluation"]

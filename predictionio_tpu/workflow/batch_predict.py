@@ -1,0 +1,56 @@
+"""Batch predict: offline bulk scoring from a query file.
+
+Behavioral model: reference ``core/.../workflow/BatchPredict.scala``
+(apache/predictionio layout, unverified -- SURVEY.md section 2.3 #26,
+v0.13+): JSON-lines queries in, JSON-lines predictions out, through the
+deployed-equivalent model chain.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from predictionio_tpu.data import storage
+from predictionio_tpu.workflow.context import RuntimeContext
+from predictionio_tpu.workflow.core_workflow import (
+    engine_params_from_instance,
+    resolve_engine_instance,
+)
+from predictionio_tpu.workflow.json_extractor import EngineVariant, build_engine
+
+
+def run_batch_predict(
+    variant: EngineVariant,
+    input_path: str,
+    output_path: str,
+    instance_id: str | None = None,
+) -> int:
+    """Score every JSON-lines query in ``input_path``; returns count."""
+    engine = build_engine(variant)
+    instance = resolve_engine_instance(variant, instance_id)
+    engine_params = engine_params_from_instance(instance)
+    blob = storage.get_model_data_models().get(instance.id)
+    ctx = RuntimeContext(instance.runtime_conf)
+    models = engine.prepare_deploy(
+        ctx, engine_params, instance.id, blob.models if blob else None
+    )
+    algorithms = engine._algorithms(engine_params)
+    serving = engine.serving(engine_params)
+
+    count = 0
+    with open(input_path) as fin, open(output_path, "w") as fout:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            query_obj = json.loads(line)
+            predictions = [
+                a.predict(m, a.query_from_json(query_obj))
+                for a, m in zip(algorithms, models)
+            ]
+            result = serving.serve(algorithms[0].query_from_json(query_obj), predictions)
+            result_json = algorithms[0].result_to_json(result)
+            fout.write(json.dumps({"query": query_obj, "prediction": result_json}) + "\n")
+            count += 1
+    return count

@@ -1,0 +1,98 @@
+"""RuntimeContext: the SparkContext replacement.
+
+Behavioral model: reference ``core/.../workflow/WorkflowContext.scala`` +
+``WorkflowParams.scala`` (apache/predictionio layout, unverified -- SURVEY.md
+section 2.3 #24). Where the reference builds a SparkContext from ``sparkConf``
+passthrough, we build a :class:`jax.sharding.Mesh` from the engine.json
+runtime section (kept under the ``sparkConf`` key for byte-compatibility,
+also accepted as ``runtimeConf``).
+
+Mesh conventions: axes named ``("data", "model")``. ``mesh_shape`` of
+``[-1, 1]`` (default) puts all devices on the data axis. Multi-host entry
+uses ``jax.distributed.initialize`` when ``PIO_COORDINATOR`` is set.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+logger = logging.getLogger("pio.workflow")
+
+
+@dataclass
+class WorkflowParams:
+    """Train-workflow knobs (reference WorkflowParams)."""
+
+    batch: str = ""
+    verbose: int = 2
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+
+class RuntimeContext:
+    """Carries the device mesh + runtime conf through DASE calls.
+
+    Built lazily: importing jax is deferred until a mesh is actually needed
+    so storage/CLI paths stay fast.
+    """
+
+    def __init__(self, runtime_conf: Mapping[str, Any] | None = None):
+        self.runtime_conf: dict[str, Any] = dict(runtime_conf or {})
+        self._mesh = None
+
+    # -- mesh construction --------------------------------------------------
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = self._build_mesh()
+        return self._mesh
+
+    def _build_mesh(self):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        if os.environ.get("PIO_COORDINATOR"):
+            # multi-host pod: one process per host, XLA collectives over ICI/DCN
+            jax.distributed.initialize(
+                coordinator_address=os.environ["PIO_COORDINATOR"],
+                num_processes=int(os.environ.get("PIO_NUM_PROCESSES", "1")),
+                process_id=int(os.environ.get("PIO_PROCESS_ID", "0")),
+            )
+        devices = jax.devices()
+        shape = self.runtime_conf.get("pio.mesh_shape", [-1, 1])
+        axes = tuple(self.runtime_conf.get("pio.mesh_axes", ("data", "model")))
+        if len(shape) != len(axes):
+            raise ValueError(
+                f"mesh_shape {shape} and mesh_axes {axes} have different ranks"
+            )
+        resolved = list(shape)
+        if -1 in resolved:
+            known = 1
+            for s in resolved:
+                if s != -1:
+                    known *= s
+            resolved[resolved.index(-1)] = len(devices) // known
+        total = 1
+        for s in resolved:
+            total *= s
+        if total > len(devices):
+            raise ValueError(
+                f"mesh shape {resolved} needs {total} devices, have {len(devices)}"
+            )
+        device_grid = np.array(devices[:total]).reshape(resolved)
+        mesh = Mesh(device_grid, axes)
+        logger.info("mesh: %s over %d %s device(s)",
+                    dict(zip(axes, resolved)), total, devices[0].platform)
+        return mesh
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def conf(self, key: str, default: Any = None) -> Any:
+        return self.runtime_conf.get(key, default)

@@ -1,0 +1,169 @@
+"""CoreWorkflow: train + evaluation runs with the instance status machine.
+
+Behavioral model: reference ``core/.../workflow/{CreateWorkflow,CoreWorkflow,
+EvaluationWorkflow}.scala`` (apache/predictionio layout, unverified --
+SURVEY.md section 2.3 #24 and section 3.1/3.4 call stacks):
+
+- train: EngineInstance QUEUED -> RUNNING -> COMPLETED (FAILED on error),
+  models serialized into the Models blob store keyed by instance id
+- evaluation: EvaluationInstance lifecycle + MetricEvaluator leaderboard
+  persisted for the dashboard
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import os
+import traceback
+
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.controller.metrics import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+)
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.storage.base import (
+    STATUS_COMPLETED,
+    STATUS_FAILED,
+    STATUS_RUNNING,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+)
+from predictionio_tpu.workflow.context import RuntimeContext, WorkflowParams
+from predictionio_tpu.workflow.json_extractor import EngineVariant, build_engine
+
+logger = logging.getLogger("pio.workflow")
+
+
+def _utcnow() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def run_train(
+    variant: EngineVariant,
+    workflow_params: WorkflowParams | None = None,
+    engine: Engine | None = None,
+) -> EngineInstance:
+    """The `pio train` core: returns the COMPLETED EngineInstance.
+
+    Raises after recording FAILED status if any DASE stage throws.
+    """
+    workflow_params = workflow_params or WorkflowParams()
+    engine = engine or build_engine(variant)
+    engine_params = variant.engine_params
+    instances = storage.get_meta_data_engine_instances()
+
+    instance = EngineInstance(
+        status=STATUS_RUNNING,
+        start_time=_utcnow(),
+        engine_id=variant.variant_id,
+        engine_version=variant.engine_version,
+        engine_variant=variant.path,
+        engine_factory=variant.engine_factory,
+        batch=workflow_params.batch,
+        env={k: v for k, v in os.environ.items() if k.startswith("PIO_")},
+        runtime_conf=variant.runtime_conf,
+        data_source_params=json.dumps(dict(engine_params.data_source_params)),
+        preparator_params=json.dumps(dict(engine_params.preparator_params)),
+        algorithms_params=json.dumps(
+            [{"name": n, "params": dict(p)} for n, p in engine_params.algorithm_params_list]
+        ),
+        serving_params=json.dumps(dict(engine_params.serving_params)),
+    )
+    instance_id = instances.insert(instance)
+    ctx = RuntimeContext(variant.runtime_conf)
+    try:
+        models = engine.train(
+            ctx, engine_params, skip_sanity_check=workflow_params.skip_sanity_check
+        )
+        blob = engine.serialize_models(ctx, engine_params, instance_id, models)
+        storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
+        instance.status = STATUS_COMPLETED
+        instance.end_time = _utcnow()
+        instances.update(instance)
+        logger.info("training finished: instance %s", instance_id)
+        return instance
+    except Exception:
+        instance.status = STATUS_FAILED
+        instance.end_time = _utcnow()
+        instances.update(instance)
+        logger.error("training FAILED: instance %s\n%s", instance_id, traceback.format_exc())
+        raise
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    generator: EngineParamsGenerator,
+    evaluation_class: str = "",
+    generator_class: str = "",
+    runtime_conf: dict | None = None,
+    batch: str = "",
+) -> EvaluationInstance:
+    """The `pio eval` core: grid-run + leaderboard, persisted for dashboard."""
+    instances = storage.get_meta_data_evaluation_instances()
+    instance = EvaluationInstance(
+        status=STATUS_RUNNING,
+        start_time=_utcnow(),
+        evaluation_class=evaluation_class,
+        engine_params_generator_class=generator_class,
+        batch=batch,
+        env={k: v for k, v in os.environ.items() if k.startswith("PIO_")},
+    )
+    instance_id = instances.insert(instance)
+    ctx = RuntimeContext(runtime_conf)
+    try:
+        result = MetricEvaluator(evaluation).run(ctx, generator)
+        metric, extras = evaluation.metric, evaluation.metrics
+        instance.status = STATUS_COMPLETED
+        instance.end_time = _utcnow()
+        instance.evaluator_results = result.leaderboard(metric, extras)
+        instance.evaluator_results_json = result.to_json(metric, extras)
+        instance.evaluator_results_html = (
+            "<pre>" + result.leaderboard(metric, extras) + "</pre>"
+        )
+        instances.update(instance)
+        logger.info("evaluation finished: instance %s", instance_id)
+        return instance
+    except Exception:
+        instance.status = STATUS_FAILED
+        instance.end_time = _utcnow()
+        instances.update(instance)
+        raise
+
+
+def resolve_engine_instance(
+    variant: EngineVariant, instance_id: str | None = None
+) -> EngineInstance:
+    """Latest COMPLETED instance for this variant (or an explicit id) --
+    the deploy-time resolution step of reference CreateServer (SURVEY 3.2)."""
+    instances = storage.get_meta_data_engine_instances()
+    if instance_id:
+        instance = instances.get(instance_id)
+        if instance is None:
+            raise LookupError(f"engine instance {instance_id!r} not found")
+        return instance
+    instance = instances.get_latest_completed(
+        variant.variant_id, variant.engine_version, variant.path
+    )
+    if instance is None:
+        raise LookupError(
+            f"no COMPLETED training of engine variant {variant.variant_id!r}"
+            f" ({variant.path}); run `pio train` first"
+        )
+    return instance
+
+
+def engine_params_from_instance(instance: EngineInstance) -> EngineParams:
+    """Reconstruct the EngineParams a training run used (deploy fidelity)."""
+    return EngineParams.from_json_obj(
+        {
+            "datasource": {"params": json.loads(instance.data_source_params)},
+            "preparator": {"params": json.loads(instance.preparator_params)},
+            "algorithms": json.loads(instance.algorithms_params),
+            "serving": {"params": json.loads(instance.serving_params)},
+        }
+    )

@@ -1,0 +1,253 @@
+"""Query Server: low-latency REST serving of a deployed engine.
+
+Behavioral model: reference ``core/.../workflow/CreateServer.scala``
+(apache/predictionio layout, unverified -- SURVEY.md section 2.3 #25, section
+3.2 call stack). Contract kept:
+
+- ``POST /queries.json``: free-form JSON query -> per-algorithm
+  ``predict`` -> ``serving.serve`` -> JSON PredictedResult (+ ``prId`` echo
+  when the feedback loop is on)
+- ``GET /``: info/status page (JSON here rather than HTML)
+- ``GET /reload``: re-resolve the latest COMPLETED instance and hot-swap
+  models
+- ``POST /stop``: shut the server down (how ``pio undeploy`` works)
+- plugin hook points: output blockers / output sniffers
+  (``EngineServerPlugin`` parity)
+- optional feedback loop: writes query/prediction events back to the Event
+  Server (``--feedback --event-server-ip/port --accesskey``)
+
+Default port 8000. Serving stays off the training mesh: predict calls are
+host-side (factor caches) or single-chip jitted functions prepared at load
+time -- the <5 ms p50 path (SURVEY.md section 7.3).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any
+
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.utils.http import (
+    Request,
+    Response,
+    Router,
+    ServiceThread,
+    make_server,
+)
+from predictionio_tpu.workflow.context import RuntimeContext
+from predictionio_tpu.workflow.core_workflow import (
+    engine_params_from_instance,
+    resolve_engine_instance,
+)
+from predictionio_tpu.workflow.json_extractor import EngineVariant, build_engine
+
+logger = logging.getLogger("pio.server")
+
+DEFAULT_PORT = 8000
+
+
+class EngineServerPlugin:
+    """Output blocker/sniffer hook points (reference EngineServerPlugin)."""
+
+    def output_blocker(self, query: Any, prediction: Any) -> None:
+        pass
+
+    def output_sniffer(self, query: Any, prediction: Any) -> None:
+        pass
+
+
+class ServerRejection(Exception):
+    def __init__(self, message: str, status: int = 403):
+        super().__init__(message)
+        self.status = status
+
+
+@dataclass
+class FeedbackConfig:
+    event_server_url: str
+    access_key: str
+
+
+class QueryService:
+    """Holds the deployed engine state; hot-swappable via /reload."""
+
+    def __init__(
+        self,
+        variant: EngineVariant,
+        engine: Engine | None = None,
+        instance_id: str | None = None,
+        feedback: FeedbackConfig | None = None,
+        plugins: list[EngineServerPlugin] | None = None,
+    ):
+        self.variant = variant
+        self.engine = engine or build_engine(variant)
+        self.requested_instance_id = instance_id
+        self.feedback = feedback
+        self.plugins = list(plugins or [])
+        self._lock = threading.RLock()
+        self._served = 0
+        self._started = _dt.datetime.now(_dt.timezone.utc)
+        self._load_models()
+
+        self.router = Router()
+        self.router.add("GET", "/", self.handle_info)
+        self.router.add("POST", "/queries.json", self.handle_query)
+        self.router.add("GET", "/reload", self.handle_reload)
+        self.router.add("POST", "/stop", self.handle_stop)
+        self._stop_event = threading.Event()
+
+    # -- model lifecycle ----------------------------------------------------
+    def _load_models(self) -> None:
+        from predictionio_tpu.data import storage
+
+        instance = resolve_engine_instance(self.variant, self.requested_instance_id)
+        engine_params = engine_params_from_instance(instance)
+        blob_record = storage.get_model_data_models().get(instance.id)
+        ctx = RuntimeContext(instance.runtime_conf)
+        models = self.engine.prepare_deploy(
+            ctx, engine_params, instance.id,
+            blob_record.models if blob_record else None,
+        )
+        algorithms = self.engine._algorithms(engine_params)
+        serving = self.engine.serving(engine_params)
+        with self._lock:
+            self.instance = instance
+            self.engine_params = engine_params
+            self.models = models
+            self.algorithms = algorithms
+            self.serving_instance = serving
+        logger.info(
+            "deployed engine instance %s (%d algorithm(s))", instance.id, len(models)
+        )
+
+    # -- handlers -----------------------------------------------------------
+    def handle_info(self, request: Request) -> Response:
+        with self._lock:
+            return Response(
+                200,
+                {
+                    "status": "alive",
+                    "engineInstance": {
+                        "id": self.instance.id,
+                        "engineVariant": self.variant.variant_id,
+                        "startTime": self.instance.start_time.isoformat(),
+                    },
+                    "algorithms": [type(a).__name__ for a in self.algorithms],
+                    "startTime": self._started.isoformat(),
+                    "serverStats": {"queryCount": self._served},
+                },
+            )
+
+    def handle_query(self, request: Request) -> Response:
+        try:
+            query_obj = request.json()
+        except json.JSONDecodeError:
+            return Response(400, {"message": "malformed JSON query"})
+        with self._lock:
+            algorithms = self.algorithms
+            models = self.models
+            serving = self.serving_instance
+        try:
+            predictions = []
+            typed_query = algorithms[0].query_from_json(query_obj)
+            for algorithm, model in zip(algorithms, models):
+                query = algorithm.query_from_json(query_obj)
+                predictions.append(algorithm.predict(model, query))
+            # serving receives the typed query, matching Engine.eval's contract
+            result = serving.serve(typed_query, predictions)
+            for plugin in self.plugins:
+                plugin.output_blocker(query_obj, result)
+        except ServerRejection as exc:
+            return Response(exc.status, {"message": str(exc)})
+        except (KeyError, TypeError, ValueError) as exc:
+            return Response(400, {"message": f"bad query: {exc}"})
+        for plugin in self.plugins:
+            plugin.output_sniffer(query_obj, result)
+        result_json = algorithms[0].result_to_json(result)
+        if not isinstance(result_json, (dict, list)):
+            result_json = {"result": result_json}
+        if self.feedback:
+            pr_id = uuid.uuid4().hex
+            if isinstance(result_json, dict):
+                result_json = {**result_json, "prId": pr_id}
+            # off the request path: feedback latency must not touch query p50
+            threading.Thread(
+                target=self._send_feedback,
+                args=(query_obj, result_json, pr_id),
+                daemon=True,
+            ).start()
+        with self._lock:
+            self._served += 1
+        return Response(200, result_json)
+
+    def handle_reload(self, request: Request) -> Response:
+        # /reload re-resolves the LATEST completed instance (hot-swap), even
+        # if the server was started pinned to an explicit instance id
+        self.requested_instance_id = None
+        self._load_models()
+        return Response(200, {"status": "reloaded", "engineInstanceId": self.instance.id})
+
+    def handle_stop(self, request: Request) -> Response:
+        self._stop_event.set()
+        return Response(200, {"status": "stopping"})
+
+    # -- feedback loop ------------------------------------------------------
+    def _send_feedback(self, query: Any, prediction: Any, pr_id: str) -> None:
+        """POST query/prediction back to the Event Server (reference
+        --feedback). Failures are logged, never surfaced to the client."""
+        import urllib.request
+
+        event = {
+            "event": "predict",
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {"query": query, "prediction": prediction},
+            "prId": pr_id,
+        }
+        url = (
+            f"{self.feedback.event_server_url}/events.json"
+            f"?accessKey={self.feedback.access_key}"
+        )
+        try:
+            req = urllib.request.Request(
+                url,
+                data=json.dumps(event).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=2)
+        except Exception as exc:
+            logger.warning("feedback event failed: %s", exc)
+
+
+def create_query_server(
+    variant: EngineVariant,
+    host: str = "0.0.0.0",
+    port: int = DEFAULT_PORT,
+    **service_kwargs,
+) -> tuple[ServiceThread, QueryService]:
+    service = QueryService(variant, **service_kwargs)
+    server = make_server(service.router, host, port, "pio-queryserver")
+    return ServiceThread(server), service
+
+
+def run_query_server(
+    variant: EngineVariant, host: str = "0.0.0.0", port: int = DEFAULT_PORT, **kw
+) -> None:
+    """Blocking entry point used by ``pio deploy``."""
+    thread, service = create_query_server(variant, host, port, **kw)
+    thread.start()
+    print(
+        f"Query Server listening on http://{host}:{port}"
+        f" (engine instance {service.instance.id})"
+    )
+    try:
+        service._stop_event.wait()
+    except KeyboardInterrupt:
+        pass
+    thread.stop()

@@ -1,0 +1,115 @@
+"""engine.json loading + engine factory resolution.
+
+Behavioral model: reference ``core/.../workflow/{JsonExtractor,WorkflowUtils}
+.scala`` (apache/predictionio layout, unverified -- SURVEY.md section 2.3 #24,
+section 5.6, Appendix B). engine.json shape kept byte-compatible; the
+``sparkConf`` section becomes the runtime conf passed to RuntimeContext
+(``runtimeConf`` accepted as an alias). ``engineFactory`` is a dotted Python
+path to a callable returning an :class:`~predictionio_tpu.controller.Engine`
+(replacing JVM reflection on an EngineFactory class).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any
+
+from predictionio_tpu.controller.engine import Engine, EngineParams
+
+
+class EngineConfigError(ValueError):
+    pass
+
+
+@dataclass
+class EngineVariant:
+    """Parsed engine.json."""
+
+    path: str
+    engine_dir: str
+    variant_id: str
+    description: str
+    engine_factory: str
+    engine_params: EngineParams
+    runtime_conf: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def engine_version(self) -> str:
+        return "1"
+
+
+def load_engine_variant(path: str) -> EngineVariant:
+    if not os.path.exists(path):
+        raise EngineConfigError(f"engine variant file not found: {path}")
+    with open(path) as f:
+        try:
+            obj = json.load(f)
+        except json.JSONDecodeError as exc:
+            raise EngineConfigError(f"{path} is not valid JSON: {exc}") from exc
+    if "engineFactory" not in obj:
+        raise EngineConfigError(f"{path} is missing required key 'engineFactory'")
+    runtime_conf = obj.get("sparkConf", {}) | obj.get("runtimeConf", {})
+    return EngineVariant(
+        path=os.path.abspath(path),
+        engine_dir=os.path.dirname(os.path.abspath(path)),
+        variant_id=obj.get("id", "default"),
+        description=obj.get("description", ""),
+        engine_factory=obj["engineFactory"],
+        engine_params=EngineParams.from_json_obj(obj),
+        runtime_conf=runtime_conf,
+    )
+
+
+def resolve_dotted(dotted: str, engine_dir: str | None = None):
+    """The one dotted-path resolver (factories, persistent model classes,
+    evaluations): walks nested qualnames, prepends the engine directory to
+    ``sys.path`` (parity role of the reference's engine-assembly classpath
+    assembly in Runner.scala), raises EngineConfigError on failure.
+    """
+    if engine_dir and engine_dir not in sys.path:
+        sys.path.insert(0, engine_dir)
+    module_path, _, attr_path = dotted.rpartition(".")
+    if not module_path:
+        raise EngineConfigError(f"{dotted!r} must be a dotted module path")
+    # qualnames may nest (Outer.Inner): retry shorter module prefixes
+    probe = module_path
+    while True:
+        try:
+            obj = importlib.import_module(probe)
+            break
+        except ModuleNotFoundError as exc:
+            if "." not in probe:
+                raise EngineConfigError(
+                    f"cannot import module for {dotted!r}: {exc}"
+                ) from exc
+            probe, _, rest = probe.rpartition(".")
+            attr_path = f"{rest}.{attr_path}"
+    for part in attr_path.split("."):
+        try:
+            obj = getattr(obj, part)
+        except AttributeError:
+            raise EngineConfigError(
+                f"{probe!r} has no attribute path {attr_path!r}"
+            ) from None
+    return obj
+
+
+def resolve_engine_factory(dotted: str, engine_dir: str | None = None):
+    return resolve_dotted(dotted, engine_dir)
+
+
+def build_engine(variant: EngineVariant) -> Engine:
+    factory = resolve_engine_factory(variant.engine_factory, variant.engine_dir)
+    engine = factory() if callable(factory) else factory
+    if hasattr(engine, "apply") and not isinstance(engine, Engine):
+        engine = engine.apply()
+    if not isinstance(engine, Engine):
+        raise EngineConfigError(
+            f"engineFactory {variant.engine_factory!r} returned"
+            f" {type(engine).__name__}, expected Engine"
+        )
+    return engine

@@ -1,0 +1,104 @@
+"""Fake DASE components (reference Engine0-style test doubles, SURVEY.md
+section 4 tier 1). A tiny deterministic 'mean rating' engine over events."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EvalInfo,
+    FirstServing,
+    Preparator,
+)
+from predictionio_tpu.controller.base import PersistentModel, SanityCheck
+from predictionio_tpu.data.store import PEventStore
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    ratings: list[tuple[str, str, float]]  # (user, item, rating)
+
+    def sanity_check(self) -> None:
+        if not self.ratings:
+            raise ValueError("no rating events found")
+
+
+class FakeDataSource(DataSource):
+    def read_training(self, ctx) -> TrainingData:
+        events = PEventStore.find(self.params.appName, event_names=["rate"])
+        return TrainingData(
+            [
+                (e.entity_id, e.target_entity_id, e.properties.get_double("rating"))
+                for e in events
+            ]
+        )
+
+    def read_eval(self, ctx):
+        td = self.read_training(ctx)
+        k = self.params.get_or("folds", 2)
+        folds = []
+        for i in range(k):
+            train = TrainingData([r for j, r in enumerate(td.ratings) if j % k != i])
+            test = [r for j, r in enumerate(td.ratings) if j % k == i]
+            queries = [({"user": u, "item": it}, rating) for u, it, rating in test]
+            folds.append((train, EvalInfo(fold=i), queries))
+        return folds
+
+
+class FakePreparator(Preparator):
+    def prepare(self, ctx, training_data: TrainingData):
+        return training_data
+
+
+class MeanModel:
+    def __init__(self, mean: float):
+        self.mean = mean
+
+
+class FakeAlgorithm(Algorithm):
+    """Predicts the global mean rating (+ optional bias param)."""
+
+    def train(self, ctx, prepared_data: TrainingData) -> MeanModel:
+        ratings = [r for _, _, r in prepared_data.ratings]
+        return MeanModel(sum(ratings) / len(ratings) + self.params.get_or("bias", 0.0))
+
+    def predict(self, model: MeanModel, query) -> dict:
+        return {"rating": model.mean}
+
+
+class RetrainAlgorithm(FakeAlgorithm):
+    persist_model = False
+
+
+class SelfSavingModel(PersistentModel, MeanModel):
+    saved: dict[str, float] = {}
+
+    def save(self, instance_id: str, params) -> bool:
+        SelfSavingModel.saved[instance_id] = self.mean
+        return True
+
+    @classmethod
+    def load(cls, instance_id: str, params) -> "SelfSavingModel":
+        return cls(cls.saved[instance_id])
+
+
+class PersistentAlgorithm(FakeAlgorithm):
+    def train(self, ctx, prepared_data):
+        base = super().train(ctx, prepared_data)
+        return SelfSavingModel(base.mean)
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=FakeDataSource,
+        preparator_class=FakePreparator,
+        algorithm_class_map={
+            "mean": FakeAlgorithm,
+            "retrain": RetrainAlgorithm,
+            "persistent": PersistentAlgorithm,
+        },
+        serving_class=FirstServing,
+    )
