@@ -1,0 +1,137 @@
+"""Round benchmark: ALS iters/sec/chip at MovieLens-20M scale.
+
+Metric definition (BASELINE.json): "ALS iters/sec/chip on MovieLens-20M";
+north star >=10x Spark-local ALS wall-clock. The reference publishes no
+numbers and Spark is not in this image (BASELINE.md), so ``vs_baseline`` is
+the measured speedup over the same computation on the host CPU backend --
+the closest available stand-in for the reference's single-machine
+``local[*]`` execution.
+
+The dataset is synthetic at ML-20M scale (the real file is unreachable:
+zero-egress container): 138k users x 27k items x 20M implicit-ish ratings
+with zipf item popularity, per-user history capped at 256 (padded-CSR
+truncation, the ALX-style layout choice).
+
+Prints ONE JSON line. Env knobs: PIO_BENCH_SCALE (edge count divisor for
+smoke runs), PIO_BENCH_PLATFORM=cpu to skip the TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def make_dataset(n_edges: int, n_users: int, n_items: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, n_users, size=n_edges, dtype=np.int64)
+    # zipf-ish item popularity via squared uniform
+    items = (np.minimum(rng.random(n_edges) ** 2.2, 0.999999) * n_items).astype(
+        np.int64
+    )
+    ratings = rng.integers(1, 6, size=n_edges).astype(np.float32)
+    return users, items, ratings
+
+
+def run_als(platform: str, data, config, iters_to_time: int) -> float:
+    """Return measured seconds per iteration (after one warmup iter)."""
+    import jax
+
+    from predictionio_tpu.parallel import als as als_mod
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devices = jax.devices(platform)
+    mesh = Mesh(np.array(devices[:1]).reshape(1, 1), ("data", "model"))
+
+    timings = []
+
+    def cb(it, uf, vf):
+        uf.block_until_ready()
+        vf.block_until_ready()
+        timings.append(time.perf_counter())
+
+    config.iterations = iters_to_time + 1
+    t0 = time.perf_counter()
+    als_mod.als_fit(data, config, mesh, callback=cb)
+    # timings[0] includes compile; average the rest
+    deltas = [t1 - t0 for t0, t1 in zip(timings[:-1], timings[1:])]
+    return sum(deltas) / len(deltas)
+
+
+def _probe_tpu(timeout_s: int = 120) -> str | None:
+    """Check TPU reachability in a SUBPROCESS: a wedged axon tunnel blocks
+    backend init indefinitely in-process, which would hang the whole bench."""
+    import subprocess
+
+    code = (
+        "import jax\n"
+        "ds = jax.devices()\n"
+        "print(ds[0].platform)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    platform = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    return platform if platform and platform != "cpu" else None
+
+
+def main() -> None:
+    want_tpu = os.environ.get("PIO_BENCH_PLATFORM", "tpu") != "cpu"
+    tpu_platform = _probe_tpu() if want_tpu else None
+
+    import jax
+
+    if tpu_platform is None:
+        # keep the wedged/absent TPU backend out of every later devices() call
+        jax.config.update("jax_platforms", "cpu")
+
+    from predictionio_tpu.parallel.als import ALSConfig, build_als_data
+
+    scale = float(os.environ.get("PIO_BENCH_SCALE", "1"))
+    n_users, n_items = int(138_000 / max(scale ** 0.5, 1)), int(27_000 / max(scale ** 0.5, 1))
+    n_edges = int(20_000_000 / scale)
+    users, items, ratings = make_dataset(n_edges, n_users, n_items)
+
+    config = ALSConfig(rank=16, reg=0.05, max_len=256)
+    data = build_als_data(users, items, ratings, n_users, n_items, config)
+
+    cpu_secs = run_als("cpu", data, ALSConfig(**vars(config)), 2)
+    if tpu_platform:
+        tpu_secs = run_als(tpu_platform, data, ALSConfig(**vars(config)), 5)
+        value = 1.0 / tpu_secs
+        vs_baseline = cpu_secs / tpu_secs
+        note = f"tpu({tpu_platform}) vs host-cpu baseline {1.0 / cpu_secs:.3f} it/s"
+    else:
+        value = 1.0 / cpu_secs
+        vs_baseline = 1.0
+        note = "cpu only (no TPU backend reachable)"
+
+    print(
+        json.dumps(
+            {
+                "metric": "als_iters_per_sec_per_chip_ml20m_scale",
+                "value": round(value, 4),
+                "unit": "iters/sec",
+                "vs_baseline": round(vs_baseline, 3),
+                "note": note,
+                "edges": n_edges,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+"""Engine templates: the model zoo the reference ecosystem ships.
+
+Reference counterparts (SURVEY.md section 2.5 #37 -- template repos define
+the zoo): recommendation (MLlib ALS), classification (NaiveBayes/LogReg),
+similar-product (cooccurrence), universal recommender (CCO/LLR), plus the
+new Neural-CF Pallas template (BASELINE.json config #5). Each template is a
+complete DASE engine usable via engine.json or programmatically.
+"""

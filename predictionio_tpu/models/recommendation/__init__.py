@@ -1,0 +1,22 @@
+"""Recommendation template: ALS matrix factorization on the TPU mesh.
+
+Reference counterpart: predictionio-template-recommender (MLlib ALS engine:
+DataSource reading rate/buy events, ALSAlgorithm wrapping
+``org.apache.spark.mllib.recommendation.ALS``, top-k serving) -- SURVEY.md
+section 2.5 #37 and BASELINE.json configs #1. The math lives in
+``predictionio_tpu.parallel.als``; this module is the DASE packaging.
+"""
+
+from predictionio_tpu.models.recommendation.engine import (
+    ALSAlgorithm,
+    RecommendationDataSource,
+    RecommendationPreparator,
+    engine_factory,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "RecommendationDataSource",
+    "RecommendationPreparator",
+    "engine_factory",
+]

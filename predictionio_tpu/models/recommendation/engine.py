@@ -1,0 +1,257 @@
+"""DASE components of the recommendation template.
+
+Query contract (reference template quickstart):
+``{"user": "u1", "num": 4}`` -> ``{"itemScores": [{"item": ..., "score": ...}]}``
+plus item-based queries ``{"items": [...], "num": k}`` for similarity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    EvalInfo,
+    FirstServing,
+    Preparator,
+    TPUAlgorithm,
+)
+from predictionio_tpu.controller.base import SanityCheck
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.parallel.als import (
+    ALSConfig,
+    ALSData,
+    ALSModel,
+    als_fit,
+    build_als_data,
+)
+
+
+@dataclass
+class RatingsData(SanityCheck):
+    """COO interactions + id vocabularies."""
+
+    users: np.ndarray       # int indices
+    items: np.ndarray
+    ratings: np.ndarray     # float32
+    times: np.ndarray       # float64 epoch seconds
+    user_ids: list[str]
+    item_ids: list[str]
+
+    def sanity_check(self) -> None:
+        if self.users.size == 0:
+            raise ValueError(
+                "no rating events found -- check appName and eventNames"
+            )
+
+    @property
+    def num_users(self) -> int:
+        return len(self.user_ids)
+
+    @property
+    def num_items(self) -> int:
+        return len(self.item_ids)
+
+
+class RecommendationDataSource(DataSource):
+    """Reads rating-like events into COO form.
+
+    Params: ``appName`` (required), ``eventNames`` (default ["rate", "buy"]),
+    ``ratingKey`` (property holding the rating; "buy"-style events without it
+    score 1.0), ``evalK``/``evalFolds`` for read_eval.
+    """
+
+    def _read(self) -> RatingsData:
+        event_names = self.params.get_or("eventNames", ["rate", "buy"])
+        ds = PEventStore.dataset(
+            self.params.appName,
+            rating_key=self.params.get_or("ratingKey", "rating"),
+            event_names=event_names,
+            target_entity_type="item",
+        )
+        ratings = np.nan_to_num(ds.ratings, nan=1.0)  # implicit events -> 1.0
+        valid = ds.target_entity_ids >= 0
+        return RatingsData(
+            users=ds.entity_ids[valid],
+            items=ds.target_entity_ids[valid],
+            ratings=ratings[valid],
+            times=ds.event_times[valid],
+            user_ids=ds.entity_id_vocab,
+            item_ids=ds.target_entity_id_vocab,
+        )
+
+    def read_training(self, ctx) -> RatingsData:
+        return self._read()
+
+    def read_eval(self, ctx):
+        """Time-ordered k-fold: hold out each fold's interactions as
+        (query, actual) pairs asking for top-`evalK` recommendations."""
+        data = self._read()
+        folds = self.params.get_or("evalFolds", 3)
+        eval_k = self.params.get_or("evalK", 10)
+        out = []
+        for f in range(folds):
+            test_mask = (np.arange(data.users.size) % folds) == f
+            train = RatingsData(
+                users=data.users[~test_mask],
+                items=data.items[~test_mask],
+                ratings=data.ratings[~test_mask],
+                times=data.times[~test_mask],
+                user_ids=data.user_ids,
+                item_ids=data.item_ids,
+            )
+            qa = {}
+            for u, i in zip(data.users[test_mask], data.items[test_mask]):
+                qa.setdefault(u, set()).add(i)
+            pairs = [
+                (
+                    {"user": data.user_ids[u], "num": eval_k},
+                    [data.item_ids[i] for i in items],
+                )
+                for u, items in qa.items()
+            ]
+            out.append((train, EvalInfo(fold=f), pairs))
+        return out
+
+
+class RecommendationPreparator(Preparator):
+    """Packs COO ratings into padded CSR blocks sized for the mesh."""
+
+    def prepare(self, ctx, training_data: RatingsData):
+        config = ALSConfig(max_len=self.params.get_or("maxEventsPerUser", None))
+        num_shards = 1
+        try:
+            num_shards = ctx.mesh.shape.get("data", 1)
+        except Exception:
+            pass  # no devices available (pure-host tests)
+        als_data = build_als_data(
+            training_data.users,
+            training_data.items,
+            training_data.ratings,
+            training_data.num_users,
+            training_data.num_items,
+            config,
+            times=training_data.times,
+            num_shards=num_shards,
+        )
+        return training_data, als_data
+
+
+@dataclass
+class RecommendationModel:
+    """Host-side serving model: factor matrices + vocab maps.
+
+    Factors are cached host-side for sub-ms top-k scoring (SURVEY.md
+    section 7.3: avoid per-request host<->device copies for factor lookups).
+    """
+
+    als: ALSModel
+    user_index: dict[str, int]
+    item_ids: list[str]
+    item_index: dict[str, int]
+    seen: dict[int, set[int]]  # user -> rated item indices (for filtering)
+
+
+class ALSAlgorithm(TPUAlgorithm):
+    """ALS on the device mesh (MLlib ALS / ALS.trainImplicit parity).
+
+    Params: rank, numIterations, lambda, alpha, implicitPrefs, seed.
+    """
+
+    def _config(self) -> ALSConfig:
+        p = self.params
+        return ALSConfig(
+            rank=p.get_or("rank", 16),
+            iterations=p.get_or("numIterations", 10),
+            reg=p.get_or("lambda", 0.1),
+            alpha=p.get_or("alpha", 40.0),
+            implicit=p.get_or("implicitPrefs", False),
+            seed=p.get_or("seed", 0),
+        )
+
+    def train(self, ctx, prepared) -> RecommendationModel:
+        ratings_data, als_data = prepared
+        config = self._config()
+        mesh = None
+        try:
+            mesh = ctx.mesh
+        except Exception:
+            mesh = None
+        model = als_fit(als_data, config, mesh)
+        seen: dict[int, set[int]] = {}
+        for u, i in zip(ratings_data.users, ratings_data.items):
+            seen.setdefault(int(u), set()).add(int(i))
+        return RecommendationModel(
+            als=model,
+            user_index={uid: idx for idx, uid in enumerate(ratings_data.user_ids)},
+            item_ids=ratings_data.item_ids,
+            item_index={iid: idx for idx, iid in enumerate(ratings_data.item_ids)},
+            seen=seen,
+        )
+
+    def predict(self, model: RecommendationModel, query) -> dict:
+        num = int(query.get("num", 10))
+        if "user" in query:
+            return self._recommend_for_user(model, query, num)
+        if "items" in query:
+            return self._similar_items(model, query, num)
+        raise ValueError("query must contain 'user' or 'items'")
+
+    def _recommend_for_user(self, model: RecommendationModel, query, num: int) -> dict:
+        user_idx = model.user_index.get(str(query["user"]))
+        if user_idx is None:
+            return {"itemScores": []}  # cold user: reference returns empty
+        scores = model.als.score_items_for_user(user_idx)
+        # blackList always applies; the seen-items filter is opt-out
+        exclude = {
+            model.item_index[b]
+            for b in (query.get("blackList") or [])
+            if b in model.item_index
+        }
+        if query.get("unseenOnly", True):
+            exclude |= model.seen.get(user_idx, set())
+        for idx in exclude:
+            scores[idx] = -np.inf
+        order = np.argsort(-scores)[:num]
+        return {
+            "itemScores": [
+                {"item": model.item_ids[i], "score": float(scores[i])}
+                for i in order
+                if np.isfinite(scores[i])
+            ]
+        }
+
+    def _similar_items(self, model: RecommendationModel, query, num: int) -> dict:
+        sims = None
+        anchors = [
+            model.item_index[str(item)]
+            for item in query["items"]
+            if str(item) in model.item_index
+        ]
+        if not anchors:
+            return {"itemScores": []}
+        for idx in anchors:
+            s = model.als.similar_items(idx)
+            sims = s if sims is None else sims + s
+        for idx in anchors:
+            sims[idx] = -np.inf
+        order = np.argsort(-sims)[:num]
+        return {
+            "itemScores": [
+                {"item": model.item_ids[i], "score": float(sims[i])}
+                for i in order
+                if np.isfinite(sims[i])
+            ]
+        }
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=RecommendationDataSource,
+        preparator_class=RecommendationPreparator,
+        algorithm_class_map={"als": ALSAlgorithm},
+        serving_class=FirstServing,
+    )

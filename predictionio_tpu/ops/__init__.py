@@ -1,0 +1,8 @@
+"""TPU compute kernels: ragged packing, segment ops, batched linear algebra.
+
+This package is the rebuild's "native layer": where the reference delegates
+math to Spark/MLlib (SURVEY.md section 2.9 -- it has no native code of its
+own), the hot ops here are jitted XLA computations and Pallas kernels
+designed for the MXU: static shapes, batched matmuls, masked instead of
+ragged control flow.
+"""

@@ -1,0 +1,21 @@
+"""Batched linear algebra for the MXU."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_solve
+from jax.lax.linalg import cholesky
+
+
+def batched_spd_solve(gram: jnp.ndarray, rhs: jnp.ndarray, jitter: float = 1e-6):
+    """Solve ``gram[b] @ x[b] = rhs[b]`` for a batch of SPD systems.
+
+    Cholesky-based: roughly 2x cheaper than LU on the K x K normal-equation
+    systems ALS produces, and numerically safe given the ridge term. A small
+    jitter guards rows whose Gram is singular (entities with no
+    interactions); their solution is ~0 because their rhs is 0.
+    """
+    k = gram.shape[-1]
+    eye = jnp.eye(k, dtype=gram.dtype)
+    chol = cholesky(gram + jitter * eye)
+    return cho_solve((chol, True), rhs[..., None])[..., 0]

@@ -1,0 +1,24 @@
+"""Parallel execution over the device mesh: the Spark-substrate replacement.
+
+Reference parallelism accounting (SURVEY.md section 2.6/2.7): the reference's
+only real strategy is RDD data parallelism over Spark's Netty shuffle, plus
+MLlib ALS's internal block model-parallelism. Here:
+
+- data parallelism  -> batch-dim sharding over the ``data`` mesh axis (pjit)
+- ALS block model-parallelism -> factors sharded over the mesh with XLA
+  collectives for block exchange (``parallel.als``, design anchor: ALX,
+  arxiv 2112.02194)
+- broadcast          -> replicated sharding (NamedSharding with None spec)
+- driver-local       -> mesh of 1
+- Spark Netty shuffle / driver RPC -> XLA collectives over ICI/DCN via
+  ``jax.distributed`` (``workflow.context`` initializes multi-host)
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    local_mesh,
+    replicated,
+    row_sharded,
+    shard_rows,
+)
+
+__all__ = ["local_mesh", "replicated", "row_sharded", "shard_rows"]

@@ -1,0 +1,43 @@
+"""Mesh + sharding helpers shared by algorithms.
+
+Conventions: axes ``("data", "model")``. Batch-parallel arrays shard their
+leading dim over ``data``; model-parallel factor blocks shard over ``model``;
+replicated arrays use an empty PartitionSpec.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def local_mesh(data: int | None = None, model: int = 1) -> Mesh:
+    """Mesh over the local devices; ``data=None`` takes all remaining."""
+    devices = jax.devices()
+    if data is None:
+        data = len(devices) // model
+    grid = np.array(devices[: data * model]).reshape(data, model)
+    return Mesh(grid, ("data", "model"))
+
+
+def row_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_rows(mesh: Mesh, *arrays, axis: str = "data"):
+    """Pad rows to the axis size and device_put sharded on the leading dim."""
+    n_shards = mesh.shape[axis]
+    out = []
+    for arr in arrays:
+        rows = arr.shape[0]
+        padded = -(-rows // n_shards) * n_shards
+        if padded != rows:
+            pad_width = [(0, padded - rows)] + [(0, 0)] * (arr.ndim - 1)
+            arr = np.pad(arr, pad_width)
+        out.append(jax.device_put(arr, row_sharded(mesh, axis)))
+    return out[0] if len(out) == 1 else tuple(out)

@@ -1,0 +1,89 @@
+"""``pio import`` / ``pio export``: bulk JSON-lines event transfer.
+
+Behavioral model: reference ``tools/.../imprt/FileToEvents.scala`` +
+``tools/.../export/EventsToFile.scala`` (apache/predictionio layout,
+unverified -- SURVEY.md section 2.4 #30). Same file format: one event JSON
+object per line, identical to the REST wire shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.event import Event, EventValidationError
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    imp = sub.add_parser("import", help="import JSON-lines events into an app")
+    imp.add_argument("--appid", type=int, required=True)
+    imp.add_argument("--channel", default=None)
+    imp.add_argument("--input", required=True)
+    imp.set_defaults(func=cmd_import)
+
+    exp = sub.add_parser("export", help="export an app's events to JSON-lines")
+    exp.add_argument("--appid", type=int, required=True)
+    exp.add_argument("--channel", default=None)
+    exp.add_argument("--output", required=True)
+    exp.add_argument("--format", choices=["json"], default="json")
+    exp.set_defaults(func=cmd_export)
+
+
+def _channel_id(app_id: int, channel_name: str | None) -> int | None:
+    if channel_name is None:
+        return None
+    for ch in storage.get_meta_data_channels().get_by_app(app_id):
+        if ch.name == channel_name:
+            return ch.id
+    raise SystemExit(f"Error: channel {channel_name!r} not found in app {app_id}")
+
+
+def cmd_import(args: argparse.Namespace) -> int:
+    if storage.get_meta_data_apps().get(args.appid) is None:
+        print(f"Error: app id {args.appid} does not exist.")
+        return 1
+    channel_id = _channel_id(args.appid, args.channel)
+    le = storage.get_l_events()
+    le.init_channel(args.appid, channel_id)
+    imported = errors = 0
+    batch: list[Event] = []
+
+    def flush():
+        nonlocal imported
+        if batch:
+            le.batch_insert(batch, args.appid, channel_id)
+            imported += len(batch)
+            batch.clear()
+
+    with open(args.input) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                batch.append(Event.from_json_obj(json.loads(line)))
+            except (json.JSONDecodeError, EventValidationError) as exc:
+                errors += 1
+                print(f"  line {lineno}: {exc}", file=sys.stderr)
+                continue
+            if len(batch) >= 5000:
+                flush()
+    flush()
+    print(f"Imported {imported} events" + (f" ({errors} rejected)" if errors else "") + ".")
+    return 0 if errors == 0 else 1
+
+
+def cmd_export(args: argparse.Namespace) -> int:
+    if storage.get_meta_data_apps().get(args.appid) is None:
+        print(f"Error: app id {args.appid} does not exist.")
+        return 1
+    channel_id = _channel_id(args.appid, args.channel)
+    count = 0
+    with open(args.output, "w") as f:
+        for event in storage.get_l_events().find(args.appid, channel_id):
+            f.write(json.dumps(event.to_json_obj()) + "\n")
+            count += 1
+    print(f"Exported {count} events to {args.output}.")
+    return 0

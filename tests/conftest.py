@@ -7,12 +7,20 @@ of the reference's Spark test fixtures. Must run before jax is imported.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon (TPU tunnel) site hook force-sets jax_platforms="axon,cpu" at
+# registration, overriding the env var, and building the axon client can
+# block on the tunnel. Override at the config level BEFORE any backend
+# initialization so tests always run on the 8-device virtual CPU mesh.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

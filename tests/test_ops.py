@@ -1,0 +1,61 @@
+"""ops layer tests: ragged packing + batched solves."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.linalg import batched_spd_solve
+from predictionio_tpu.ops.ragged import pack_padded_csr
+
+
+class TestPackPaddedCSR:
+    def test_basic_packing(self):
+        rows = np.array([0, 0, 2, 2, 2])
+        cols = np.array([1, 3, 0, 1, 2])
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0], dtype=np.float32)
+        p = pack_padded_csr(rows, cols, vals, num_rows=3, num_cols=4)
+        assert p.indices.shape[0] >= 3 and p.indices.shape[1] >= 3
+        assert p.mask[0].sum() == 2 and p.mask[1].sum() == 0 and p.mask[2].sum() == 3
+        # padding slots point at the sentinel column
+        assert p.indices[1, 0] == 4
+        got = sorted(zip(p.indices[2][p.mask[2] > 0], p.values[2][p.mask[2] > 0]))
+        assert got == [(0, 3.0), (1, 4.0), (2, 5.0)]
+        assert p.truncated == 0
+
+    def test_truncation_keeps_most_recent(self):
+        rows = np.zeros(20, dtype=int)
+        cols = np.arange(20)
+        vals = np.ones(20, dtype=np.float32)
+        times = np.arange(20, dtype=np.float64)
+        p = pack_padded_csr(rows, cols, vals, 1, 20, max_len=8, times=times)
+        kept = set(p.indices[0][p.mask[0] > 0])
+        assert kept == set(range(12, 20))  # most recent 8
+        assert p.truncated == 12
+
+    def test_row_multiple_alignment(self):
+        p = pack_padded_csr(
+            np.array([0]), np.array([0]), np.array([1.0]), 5, 3, row_multiple=8
+        )
+        assert p.indices.shape[0] == 8
+        assert p.num_rows == 5
+
+    def test_empty(self):
+        p = pack_padded_csr(np.array([]), np.array([]), np.array([]), 4, 7)
+        assert p.mask.sum() == 0
+        assert (p.indices == 7).all()
+
+
+class TestBatchedSolve:
+    def test_solves_spd_batch(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(6, 5, 5)).astype(np.float32)
+        gram = np.einsum("bij,bkj->bik", a, a) + 0.5 * np.eye(5, dtype=np.float32)
+        x_true = rng.normal(size=(6, 5)).astype(np.float32)
+        rhs = np.einsum("bij,bj->bi", gram, x_true)
+        x = np.asarray(batched_spd_solve(gram, rhs))
+        assert np.abs(x - x_true).max() < 1e-3
+
+    def test_singular_rows_stay_finite(self):
+        gram = np.zeros((2, 4, 4), dtype=np.float32)
+        rhs = np.zeros((2, 4), dtype=np.float32)
+        x = np.asarray(batched_spd_solve(gram, rhs))
+        assert np.isfinite(x).all()

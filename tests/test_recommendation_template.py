@@ -1,0 +1,164 @@
+"""Recommendation template end-to-end (BASELINE config #1 shape): events ->
+train -> deploy-equivalent predict, with structured preferences so ranking
+quality is assertable."""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.models.recommendation import engine_factory
+from predictionio_tpu.workflow.context import RuntimeContext
+from predictionio_tpu.controller.engine import EngineParams
+
+
+@pytest.fixture()
+def movie_app(storage_env):
+    """Two user cliques with disjoint tastes: sci-fi lovers rate s* high,
+    romance lovers rate r* high; a few cross ratings are low."""
+    app_id = storage_env.get_meta_data_apps().insert(App(name="MovieApp"))
+    le = storage_env.get_l_events()
+    le.init_channel(app_id)
+    rng = np.random.default_rng(7)
+    events = []
+    scifi = [f"s{i}" for i in range(6)]
+    romance = [f"r{i}" for i in range(6)]
+    for g, (liked, other) in enumerate([(scifi, romance), (romance, scifi)]):
+        for u in range(8):
+            user = f"g{g}u{u}"
+            for item in rng.choice(liked, size=4, replace=False):
+                events.append((user, item, float(rng.integers(4, 6))))
+            item = rng.choice(other)
+            events.append((user, str(item), float(rng.integers(1, 3))))
+    le.batch_insert(
+        [
+            Event(event="rate", entity_type="user", entity_id=u,
+                  target_entity_type="item", target_entity_id=i,
+                  properties=DataMap({"rating": r}))
+            for u, i, r in events
+        ],
+        app_id=app_id,
+    )
+    return app_id
+
+
+def make_params(**algo):
+    return EngineParams.from_json_obj(
+        {
+            "datasource": {"params": {"appName": "MovieApp"}},
+            "algorithms": [{"name": "als", "params": algo}],
+        }
+    )
+
+
+class TestRecommendationEngine:
+    def test_train_and_recommend(self, movie_app):
+        engine = engine_factory()
+        ctx = RuntimeContext()
+        params = make_params(rank=8, numIterations=10, **{"lambda": 0.05}, seed=3)
+        models = engine.train(ctx, params)
+        algo = engine._algorithms(params)[0]
+        # sci-fi user should get sci-fi recommendations
+        # user rated 4 of 6 sci-fi items -> exactly 2 unseen sci-fi remain
+        result = algo.predict(models[0], {"user": "g0u0", "num": 2})
+        items = [s["item"] for s in result["itemScores"]]
+        assert len(items) == 2
+        assert all(i.startswith("s") for i in items), items
+        # scores sorted descending
+        scores = [s["score"] for s in result["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unseen_only_filters_rated(self, movie_app):
+        engine = engine_factory()
+        ctx = RuntimeContext()
+        params = make_params(rank=8, numIterations=6, **{"lambda": 0.05})
+        models = engine.train(ctx, params)
+        algo = engine._algorithms(params)[0]
+        rated = {
+            e.target_entity_id
+            for e in __import__("predictionio_tpu.data.store", fromlist=["PEventStore"])
+            .PEventStore.find("MovieApp", entity_id="g0u0")
+        }
+        result = algo.predict(models[0], {"user": "g0u0", "num": 12})
+        recommended = {s["item"] for s in result["itemScores"]}
+        assert not (recommended & rated)
+        seen_ok = algo.predict(models[0], {"user": "g0u0", "num": 12, "unseenOnly": False})
+        assert {s["item"] for s in seen_ok["itemScores"]} & rated
+
+    def test_cold_user_and_similar_items(self, movie_app):
+        engine = engine_factory()
+        ctx = RuntimeContext()
+        params = make_params(rank=8, numIterations=6, **{"lambda": 0.05})
+        models = engine.train(ctx, params)
+        algo = engine._algorithms(params)[0]
+        assert algo.predict(models[0], {"user": "nobody", "num": 5}) == {"itemScores": []}
+        sim = algo.predict(models[0], {"items": ["s0"], "num": 4})
+        sim_items = [s["item"] for s in sim["itemScores"]]
+        assert "s0" not in sim_items
+        assert sum(i.startswith("s") for i in sim_items) >= 3
+        with pytest.raises(ValueError):
+            algo.predict(models[0], {"num": 3})
+
+    def test_full_cli_train_deploy(self, movie_app, tmp_path):
+        """engine.json -> run_train -> query server round-trip."""
+        import requests
+
+        from predictionio_tpu.workflow.core_workflow import run_train
+        from predictionio_tpu.workflow.create_server import create_query_server
+        from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+        variant_path = tmp_path / "engine.json"
+        variant_path.write_text(
+            json.dumps(
+                {
+                    "id": "rec-test",
+                    "engineFactory": "predictionio_tpu.models.recommendation.engine_factory",
+                    "datasource": {"params": {"appName": "MovieApp"}},
+                    "algorithms": [
+                        {"name": "als",
+                         "params": {"rank": 8, "numIterations": 6, "lambda": 0.05}}
+                    ],
+                }
+            )
+        )
+        variant = load_engine_variant(str(variant_path))
+        instance = run_train(variant)
+        thread, service = create_query_server(variant, host="127.0.0.1", port=0)
+        thread.start()
+        try:
+            r = requests.post(
+                f"http://127.0.0.1:{thread.port}/queries.json",
+                json={"user": "g1u1", "num": 2},
+            )
+            assert r.status_code == 200
+            items = [s["item"] for s in r.json()["itemScores"]]
+            assert len(items) == 2 and all(i.startswith("r") for i in items)
+        finally:
+            thread.stop()
+
+    def test_evaluation_precision_at_k(self, movie_app):
+        from predictionio_tpu.controller.metrics import (
+            EngineParamsGenerator,
+            Evaluation,
+            OptionAverageMetric,
+        )
+        from predictionio_tpu.workflow.core_workflow import run_evaluation
+
+        def precision(eval_info, query, prediction, actual):
+            got = [s["item"] for s in prediction["itemScores"]]
+            if not got:
+                return None
+            return len(set(got) & set(actual)) / len(got)
+
+        evaluation = Evaluation(
+            engine=engine_factory(), metric=OptionAverageMetric(score=precision)
+        )
+        gen = EngineParamsGenerator(
+            [make_params(rank=8, numIterations=6, **{"lambda": 0.05}, seed=s)
+             for s in (0,)]
+        )
+        instance = run_evaluation(evaluation, gen)
+        results = json.loads(instance.evaluator_results_json)
+        assert results["bestScore"] > 0.15  # far above random (12 items)
