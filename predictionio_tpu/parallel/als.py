@@ -171,14 +171,22 @@ def als_fit(
 
     mesh = mesh or local_mesh(1, 1)
     dtype = jnp.dtype(config.dtype)
-    rng = np.random.default_rng(config.seed)
     scale = 1.0 / np.sqrt(config.rank)
-    users0 = (rng.normal(size=(data.by_row.indices.shape[0], config.rank)) * scale)
-    items0 = (rng.normal(size=(data.by_col.indices.shape[0], config.rank)) * scale)
-    # phantom rows (row-count padding) start at ZERO so they are invisible to
-    # the implicit-mode global Gram; with no observations they stay ~0
-    users0[data.by_row.num_rows:] = 0.0
-    items0[data.by_col.num_rows:] = 0.0
+
+    def init_factors(num_real: int, num_padded: int, seed: int) -> np.ndarray:
+        # draw exactly the real rows from a dedicated stream, then zero-pad:
+        # init is invariant to shard-count-dependent padding, and phantom
+        # rows stay invisible to the implicit-mode global Gram
+        rng = np.random.default_rng(seed)
+        real = rng.normal(size=(num_real, config.rank)) * scale
+        return np.pad(real, ((0, num_padded - num_real), (0, 0)))
+
+    users0 = init_factors(
+        data.by_row.num_rows, data.by_row.indices.shape[0], config.seed
+    )
+    items0 = init_factors(
+        data.by_col.num_rows, data.by_col.indices.shape[0], config.seed + 1
+    )
 
     row = NamedSharding(mesh, PartitionSpec("data"))
     rep = NamedSharding(mesh, PartitionSpec())
