@@ -1,0 +1,26 @@
+"""Classification template: NaiveBayes / LogisticRegression on JAX.
+
+Reference counterpart: predictionio-template-classification (MLlib
+NaiveBayes over labeled entity properties) -- SURVEY.md section 2.5 #37,
+BASELINE.json config #2 (SMS-spam events). Two data modes:
+
+- "properties": aggregate ``$set`` entity properties; ``attributeFields``
+  become features, ``labelField`` the class (stock template parity);
+- "text": events carrying a text property (SMS bodies), feature-hashed.
+"""
+
+from predictionio_tpu.models.classification.engine import (
+    ClassificationDataSource,
+    ClassificationPreparator,
+    LogisticRegressionAlgorithm,
+    NaiveBayesAlgorithm,
+    engine_factory,
+)
+
+__all__ = [
+    "ClassificationDataSource",
+    "ClassificationPreparator",
+    "LogisticRegressionAlgorithm",
+    "NaiveBayesAlgorithm",
+    "engine_factory",
+]

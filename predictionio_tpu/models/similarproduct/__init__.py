@@ -1,0 +1,16 @@
+"""Similar-Product template: item-item cooccurrence over implicit events.
+
+Reference counterpart: predictionio-template-similar-product -- SURVEY.md
+section 2.5 #37, BASELINE.json config #3 ("item-item cooccurrence over
+implicit view/buy events"). Cooccurrence runs as chunked one-hot matmuls on
+the MXU (``ops.cooccurrence``); optional LLR weighting de-noises popular
+items.
+"""
+
+from predictionio_tpu.models.similarproduct.engine import (
+    CooccurrenceAlgorithm,
+    SimilarProductDataSource,
+    engine_factory,
+)
+
+__all__ = ["CooccurrenceAlgorithm", "SimilarProductDataSource", "engine_factory"]

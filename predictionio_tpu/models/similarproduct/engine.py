@@ -1,0 +1,181 @@
+"""DASE components of the similar-product template.
+
+Query contract: ``{"items": ["i1"], "num": 4, "blackList": [...]}`` ->
+``{"itemScores": [{"item": ..., "score": ...}]}``; a ``{"user": ...}`` query
+anchors on the user's own interaction history.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    EvalInfo,
+    FirstServing,
+    IdentityPreparator,
+    TPUAlgorithm,
+)
+from predictionio_tpu.controller.base import SanityCheck
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.cooccurrence import (
+    cooccurrence,
+    llr_scores,
+    top_k_sparsify,
+)
+from predictionio_tpu.ops.ragged import pack_padded_csr
+
+
+@dataclass
+class InteractionData(SanityCheck):
+    users: np.ndarray
+    items: np.ndarray
+    times: np.ndarray
+    user_ids: list[str]
+    item_ids: list[str]
+
+    def sanity_check(self) -> None:
+        if self.users.size == 0:
+            raise ValueError("no interaction events found")
+
+
+class SimilarProductDataSource(DataSource):
+    """Params: appName, eventNames (default ["view", "buy"]), maxEventsPerUser."""
+
+    def _read(self) -> InteractionData:
+        ds = PEventStore.dataset(
+            self.params.appName,
+            event_names=self.params.get_or("eventNames", ["view", "buy"]),
+            target_entity_type="item",
+        )
+        valid = ds.target_entity_ids >= 0
+        return InteractionData(
+            users=ds.entity_ids[valid],
+            items=ds.target_entity_ids[valid],
+            times=ds.event_times[valid],
+            user_ids=ds.entity_id_vocab,
+            item_ids=ds.target_entity_id_vocab,
+        )
+
+    def read_training(self, ctx) -> InteractionData:
+        return self._read()
+
+    def read_eval(self, ctx):
+        """Hold out each user's most recent interaction; query with the rest."""
+        data = self._read()
+        data.sanity_check()  # empty store: fail with the real message, not IndexError
+        order = np.lexsort((data.times, data.users))
+        users, items = data.users[order], data.items[order]
+        last_of_user = np.r_[users[1:] != users[:-1], True]
+        train_mask = ~last_of_user
+        history: dict[int, list[int]] = {}
+        for u, i, keep in zip(users, items, train_mask):
+            if keep:
+                history.setdefault(int(u), []).append(int(i))
+        pairs = []
+        for u, i, is_last in zip(users, items, last_of_user):
+            if is_last and history.get(int(u)):
+                pairs.append(
+                    (
+                        {
+                            "items": [data.item_ids[j] for j in history[int(u)]],
+                            "num": self.params.get_or("evalK", 10),
+                        },
+                        [data.item_ids[int(i)]],
+                    )
+                )
+        train = InteractionData(
+            users=users[train_mask],
+            items=items[train_mask],
+            times=data.times[order][train_mask],
+            user_ids=data.user_ids,
+            item_ids=data.item_ids,
+        )
+        return [(train, EvalInfo(fold=0), pairs)]
+
+
+@dataclass
+class SimilarityModel:
+    item_ids: list[str]
+    item_index: dict[str, int]
+    top_indices: np.ndarray  # [items, k]
+    top_values: np.ndarray   # [items, k]
+    user_history: dict[str, list[int]]
+
+
+class CooccurrenceAlgorithm(TPUAlgorithm):
+    """Params: topK (indicators per item, default 50), llr (default True),
+    chunk (users per device matmul chunk)."""
+
+    def train(self, ctx, data: InteractionData) -> SimilarityModel:
+        csr = pack_padded_csr(
+            data.users,
+            data.items,
+            np.ones(data.users.size, dtype=np.float32),
+            num_rows=len(data.user_ids),
+            num_cols=len(data.item_ids),
+            times=data.times,
+            max_len=self.params.get_or("maxEventsPerUser", None),
+        )
+        cooc = cooccurrence(csr, chunk=self.params.get_or("chunk", 4096))
+        if self.params.get_or("llr", True):
+            totals = np.diag(cooc).copy()
+            matrix = llr_scores(cooc, totals, totals, total=len(data.user_ids))
+        else:
+            matrix = cooc
+        idx, vals = top_k_sparsify(matrix, self.params.get_or("topK", 50))
+        history: dict[str, list[int]] = {}
+        for u, i in zip(data.users, data.items):
+            history.setdefault(data.user_ids[int(u)], []).append(int(i))
+        return SimilarityModel(
+            item_ids=data.item_ids,
+            item_index={iid: j for j, iid in enumerate(data.item_ids)},
+            top_indices=idx,
+            top_values=vals,
+            user_history=history,
+        )
+
+    def predict(self, model: SimilarityModel, query) -> dict:
+        num = int(query.get("num", 10))
+        if "items" in query:
+            anchors = [
+                model.item_index[str(i)]
+                for i in query["items"]
+                if str(i) in model.item_index
+            ]
+        elif "user" in query:
+            anchors = model.user_history.get(str(query["user"]), [])
+        else:
+            raise ValueError("query must contain 'items' or 'user'")
+        if not anchors:
+            return {"itemScores": []}
+        scores: dict[int, float] = {}
+        for a in anchors:
+            for j, v in zip(model.top_indices[a], model.top_values[a]):
+                if v > 0:
+                    scores[int(j)] = scores.get(int(j), 0.0) + float(v)
+        exclude = set(anchors)
+        for b in query.get("blackList") or []:
+            if str(b) in model.item_index:
+                exclude.add(model.item_index[str(b)])
+        ranked = sorted(
+            ((j, s) for j, s in scores.items() if j not in exclude),
+            key=lambda kv: -kv[1],
+        )[:num]
+        return {
+            "itemScores": [
+                {"item": model.item_ids[j], "score": s} for j, s in ranked
+            ]
+        }
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=SimilarProductDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_class_map={"cooccurrence": CooccurrenceAlgorithm},
+        serving_class=FirstServing,
+    )

@@ -1,0 +1,18 @@
+"""Universal Recommender template: correlated cross-occurrence + LLR.
+
+Reference counterpart: the community Universal Recommender (Mahout CCO/LLR
+scored through Elasticsearch) -- SURVEY.md section 2.5 #37, BASELINE.json
+config #4. Multi-event: the FIRST name in ``eventNames`` is the primary
+(conversion) event; every other type contributes a cross-occurrence
+indicator matrix ``LLR(A_primary^T A_t)``. Scoring sums indicator weights
+over the user's per-type histories, with business rules (blacklist,
+property filters/boosts) applied host-side at serving time.
+"""
+
+from predictionio_tpu.models.universal.engine import (
+    URAlgorithm,
+    URDataSource,
+    engine_factory,
+)
+
+__all__ = ["URAlgorithm", "URDataSource", "engine_factory"]

@@ -1,0 +1,273 @@
+"""DASE components of the Universal Recommender template.
+
+Query contract: ``{"user": "u1", "num": 4, "blackList": [...],
+"fields": [{"name": "category", "values": ["books"], "bias": -1}]}``
+-> ``{"itemScores": [...]}``. ``bias < 0`` filters, ``bias >= 0``
+multiplies matching items' scores (UR business-rule semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    EvalInfo,
+    FirstServing,
+    IdentityPreparator,
+    TPUAlgorithm,
+)
+from predictionio_tpu.controller.base import SanityCheck
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.cooccurrence import (
+    cooccurrence,
+    llr_scores,
+    top_k_sparsify,
+)
+from predictionio_tpu.ops.ragged import pack_padded_csr
+
+
+@dataclass
+class MultiEventData(SanityCheck):
+    """Per-event-type COO interactions over one shared user/item universe."""
+
+    event_names: list[str]                      # [0] is primary
+    per_event: dict[str, tuple[np.ndarray, np.ndarray, np.ndarray]]  # (u, i, t)
+    user_ids: list[str]
+    item_ids: list[str]
+    item_properties: dict[str, dict]            # item id -> properties
+
+    def sanity_check(self) -> None:
+        primary = self.event_names[0]
+        if primary not in self.per_event or self.per_event[primary][0].size == 0:
+            raise ValueError(f"no events of primary type {primary!r} found")
+
+
+class URDataSource(DataSource):
+    """Params: appName, eventNames (primary first; default ["buy", "view"])."""
+
+    def _read(self) -> MultiEventData:
+        event_names = self.params.get_or("eventNames", ["buy", "view"])
+        events = PEventStore.find(
+            self.params.appName,
+            event_names=event_names,
+            target_entity_type="item",
+        )
+        user_index: dict[str, int] = {}
+        item_index: dict[str, int] = {}
+        raw: dict[str, list[tuple[int, int, float]]] = {n: [] for n in event_names}
+        for e in events:
+            if e.target_entity_id is None:
+                continue
+            u = user_index.setdefault(e.entity_id, len(user_index))
+            i = item_index.setdefault(e.target_entity_id, len(item_index))
+            raw[e.event].append((u, i, e.event_time.timestamp()))
+        per_event = {}
+        for name, triples in raw.items():
+            if triples:
+                arr = np.array(triples, dtype=np.float64)
+                per_event[name] = (
+                    arr[:, 0].astype(np.int64),
+                    arr[:, 1].astype(np.int64),
+                    arr[:, 2],
+                )
+            else:
+                per_event[name] = (
+                    np.zeros(0, np.int64),
+                    np.zeros(0, np.int64),
+                    np.zeros(0, np.float64),
+                )
+        item_props = {
+            iid: pm.to_dict()
+            for iid, pm in PEventStore.aggregate_properties(
+                self.params.appName, entity_type="item"
+            ).items()
+        }
+        return MultiEventData(
+            event_names=list(event_names),
+            per_event=per_event,
+            user_ids=list(user_index),
+            item_ids=list(item_index),
+            item_properties=item_props,
+        )
+
+    def read_training(self, ctx) -> MultiEventData:
+        return self._read()
+
+    def read_eval(self, ctx):
+        """Hold out each user's most recent PRIMARY interaction."""
+        data = self._read()
+        data.sanity_check()  # empty store: fail with the real message, not IndexError
+        primary = data.event_names[0]
+        u, i, t = data.per_event[primary]
+        order = np.lexsort((t, u))
+        u, i, t = u[order], i[order], t[order]
+        last = np.r_[u[1:] != u[:-1], True]
+        held = {int(uu): int(ii) for uu, ii, l in zip(u, i, last) if l}
+        train = MultiEventData(
+            event_names=data.event_names,
+            per_event={
+                **data.per_event,
+                primary: (u[~last], i[~last], t[~last]),
+            },
+            user_ids=data.user_ids,
+            item_ids=data.item_ids,
+            item_properties=data.item_properties,
+        )
+        pairs = [
+            (
+                {"user": data.user_ids[uu], "num": self.params.get_or("evalK", 10)},
+                [data.item_ids[ii]],
+            )
+            for uu, ii in held.items()
+        ]
+        return [(train, EvalInfo(fold=0), pairs)]
+
+
+@dataclass
+class URModel:
+    event_names: list[str]
+    item_ids: list[str]
+    item_index: dict[str, int]
+    #: per event type: (top_indices [items_t?, k]... keyed by anchor item)
+    indicators: dict[str, tuple[np.ndarray, np.ndarray]]
+    #: user id -> {event type -> [item indices]}
+    user_history: dict[str, dict[str, list[int]]]
+    item_properties: dict[str, dict]
+
+
+class URAlgorithm(TPUAlgorithm):
+    """Params: topK (indicators per anchor, default 50), maxEventsPerUser,
+    chunk."""
+
+    def train(self, ctx, data: MultiEventData) -> URModel:
+        n_users, n_items = len(data.user_ids), len(data.item_ids)
+        max_len = self.params.get_or("maxEventsPerUser", None)
+        chunk = self.params.get_or("chunk", 4096)
+        top_k = self.params.get_or("topK", 50)
+
+        def to_csr(triples):
+            uu, ii, tt = triples
+            return pack_padded_csr(
+                uu, ii, np.ones(uu.size, np.float32), n_users, n_items,
+                times=tt, max_len=max_len,
+            )
+
+        from predictionio_tpu.ops.cooccurrence import distinct_user_counts
+
+        primary_csr = to_csr(data.per_event[data.event_names[0]])
+        # diagonals are distinct-user counts: O(nnz) on host, no extra matmuls
+        primary_counts = distinct_user_counts(primary_csr)
+        indicators: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        for name in data.event_names:
+            if data.per_event[name][0].size == 0:
+                continue
+            csr = primary_csr if name == data.event_names[0] else to_csr(
+                data.per_event[name]
+            )
+            cooc = cooccurrence(primary_csr, csr, chunk=chunk)
+            col_counts = (
+                primary_counts
+                if name == data.event_names[0]
+                else distinct_user_counts(csr)
+            )
+            llr = llr_scores(cooc, primary_counts, col_counts, total=n_users)
+            indicators[name] = top_k_sparsify(
+                llr, top_k, drop_diagonal=(name == data.event_names[0])
+            )
+        history: dict[str, dict[str, list[int]]] = {}
+        for name in data.event_names:
+            uu, ii, _ = data.per_event[name]
+            for u, i in zip(uu, ii):
+                history.setdefault(data.user_ids[int(u)], {}).setdefault(
+                    name, []
+                ).append(int(i))
+        return URModel(
+            event_names=data.event_names,
+            item_ids=data.item_ids,
+            item_index={iid: j for j, iid in enumerate(data.item_ids)},
+            indicators=indicators,
+            user_history=history,
+            item_properties=data.item_properties,
+        )
+
+    def predict(self, model: URModel, query) -> dict:
+        num = int(query.get("num", 10))
+        history = dict(model.user_history.get(str(query.get("user", "")), {}))
+        # item-anchored queries act as view-history of the primary type
+        if "items" in query:
+            anchors = [
+                model.item_index[str(i)]
+                for i in query["items"]
+                if str(i) in model.item_index
+            ]
+            history[model.event_names[0]] = (
+                history.get(model.event_names[0], []) + anchors
+            )
+        if not history:
+            return {"itemScores": []}
+        # indicators[name] keeps, per PRIMARY item p, its top-k correlated
+        # type-t items: score(p) = sum of weights where p's top-k contains a
+        # history item (CCO scoring, O(items * k) per history item)
+        scores = np.zeros(len(model.item_ids), dtype=np.float64)
+        for name, items in history.items():
+            ind = model.indicators.get(name)
+            if ind is None:
+                continue
+            idx, vals = ind
+            # find rows whose top-k contains the user's history items:
+            # row p gets credit when any history item j appears in idx[p]
+            for j in set(items):
+                hits = idx == j
+                scores += (vals * hits).sum(axis=1)
+        exclude = {
+            j
+            for items in history.values()
+            for j in items
+        } if query.get("unseenOnly", True) else set()
+        for b in query.get("blackList") or []:
+            if str(b) in model.item_index:
+                exclude.add(model.item_index[str(b)])
+        # business rules: fields filters/boosts over item properties
+        multipliers = np.ones(len(model.item_ids))
+        for rule in query.get("fields") or []:
+            name, values = rule.get("name"), set(map(str, rule.get("values", [])))
+            bias = float(rule.get("bias", -1))
+            matches = np.array(
+                [
+                    str(model.item_properties.get(iid, {}).get(name)) in values
+                    or bool(
+                        isinstance(model.item_properties.get(iid, {}).get(name), list)
+                        and values
+                        & set(map(str, model.item_properties[iid][name]))
+                    )
+                    for iid in model.item_ids
+                ]
+            )
+            if bias < 0:
+                multipliers *= np.where(matches, 1.0, 0.0)
+            else:
+                multipliers *= np.where(matches, bias, 1.0)
+        scores = scores * multipliers
+        for j in exclude:
+            scores[j] = 0.0
+        order = np.argsort(-scores)[:num]
+        return {
+            "itemScores": [
+                {"item": model.item_ids[j], "score": float(scores[j])}
+                for j in order
+                if scores[j] > 0
+            ]
+        }
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=URDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_class_map={"ur": URAlgorithm},
+        serving_class=FirstServing,
+    )

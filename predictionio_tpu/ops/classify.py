@@ -1,0 +1,116 @@
+"""Classification kernels: multinomial Naive Bayes + logistic regression.
+
+TPU-native replacements for the MLlib algorithms the stock classification
+template invokes (``org.apache.spark.mllib.classification.{NaiveBayes,
+LogisticRegressionWithLBFGS}`` -- Spark deps, SURVEY.md section 2.8):
+
+- NB training is ONE matmul: ``onehot(labels).T @ X`` gives the class-
+  conditional count matrix on the MXU; smoothing + log happens elementwise.
+- LogReg trains full-batch with optax (L-BFGS when available, matching
+  MLlib's optimizer; Adam fallback), all jitted.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@dataclass
+class NaiveBayesModel:
+    log_prior: np.ndarray       # [C]
+    log_likelihood: np.ndarray  # [C, D]
+
+    def scores(self, x: np.ndarray) -> np.ndarray:
+        """Log-posterior (unnormalized) per class: [n, C]."""
+        return x @ self.log_likelihood.T + self.log_prior
+
+
+def train_naive_bayes(
+    x: np.ndarray, y: np.ndarray, num_classes: int, smoothing: float = 1.0
+) -> NaiveBayesModel:
+    # multinomial NB is defined over counts; negative features would poison
+    # the log with NaNs (MLlib's NaiveBayes rejects them the same way)
+    if np.min(x) < 0:
+        raise ValueError(
+            "NaiveBayes requires non-negative features (multinomial counts);"
+            " use logistic-regression for signed features"
+        )
+    @jax.jit
+    def _fit(x, y):
+        onehot = jax.nn.one_hot(y, num_classes, dtype=x.dtype)       # [n, C]
+        counts = onehot.T @ x                                        # [C, D] one MXU pass
+        class_counts = onehot.sum(axis=0)                            # [C]
+        log_prior = jnp.log(class_counts + smoothing) - jnp.log(
+            y.shape[0] + num_classes * smoothing
+        )
+        smoothed = counts + smoothing
+        log_likelihood = jnp.log(smoothed) - jnp.log(
+            smoothed.sum(axis=1, keepdims=True)
+        )
+        return log_prior, log_likelihood
+
+    log_prior, log_likelihood = _fit(jnp.asarray(x), jnp.asarray(y))
+    return NaiveBayesModel(np.asarray(log_prior), np.asarray(log_likelihood))
+
+
+@dataclass
+class LogisticRegressionModel:
+    weights: np.ndarray  # [D, C]
+    bias: np.ndarray     # [C]
+
+    def scores(self, x: np.ndarray) -> np.ndarray:
+        logits = x @ self.weights + self.bias
+        e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+
+def train_logistic_regression(
+    x: np.ndarray,
+    y: np.ndarray,
+    num_classes: int,
+    reg: float = 1e-4,
+    iterations: int = 100,
+    learning_rate: float = 0.1,
+) -> LogisticRegressionModel:
+    x_j = jnp.asarray(x)
+    y_j = jnp.asarray(y)
+    dim = x.shape[1]
+    params = {
+        "w": jnp.zeros((dim, num_classes), dtype=jnp.float32),
+        "b": jnp.zeros((num_classes,), dtype=jnp.float32),
+    }
+
+    def loss_fn(p):
+        logits = x_j @ p["w"] + p["b"]
+        nll = optax.softmax_cross_entropy_with_integer_labels(logits, y_j).mean()
+        return nll + reg * (p["w"] ** 2).sum()
+
+    if hasattr(optax, "lbfgs"):
+        opt = optax.lbfgs()
+        value_and_grad = optax.value_and_grad_from_state(loss_fn)
+
+        @jax.jit
+        def step(p, state):
+            value, grad = value_and_grad(p, state=state)
+            updates, state = opt.update(
+                grad, state, p, value=value, grad=grad, value_fn=loss_fn
+            )
+            return optax.apply_updates(p, updates), state
+    else:  # pragma: no cover - older optax
+        opt = optax.adam(learning_rate)
+
+        @jax.jit
+        def step(p, state):
+            grad = jax.grad(loss_fn)(p)
+            updates, state = opt.update(grad, state, p)
+            return optax.apply_updates(p, updates), state
+
+    state = opt.init(params)
+    for _ in range(iterations):
+        params, state = step(params, state)
+    return LogisticRegressionModel(np.asarray(params["w"]), np.asarray(params["b"]))

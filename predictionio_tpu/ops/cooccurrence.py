@@ -1,0 +1,139 @@
+"""Item-item cooccurrence + LLR scoring kernels.
+
+TPU-native replacement for the similar-product template's cooccurrence logic
+and the Universal Recommender's correlated cross-occurrence (CCO) with
+log-likelihood-ratio scoring (community template, Mahout CCO -- SURVEY.md
+section 2.5 #37, BASELINE.json configs #3/#4).
+
+Design: cooccurrence is a matmul. With the user-history one-hot matrix
+``A [users, items]``, the cooccurrence of primary events with event-type-t
+events is ``A_primary^T @ A_t`` -- the MXU's favorite shape. Users stream
+through in chunks (host builds each dense chunk from the padded CSR); the
+``[items, items]`` accumulator lives on device. LLR is then elementwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.ops.ragged import PaddedCSR
+
+
+@functools.partial(jax.jit, static_argnames=("num_cols",), donate_argnums=(3,))
+def _accumulate_chunk(indices, mask, other_onehot, acc, *, num_cols):
+    """acc += onehot(indices)^T @ other_onehot for one user chunk."""
+    chunk = indices.shape[0]
+    rows = jnp.repeat(jnp.arange(chunk), indices.shape[1])
+    onehot = jnp.zeros((chunk, num_cols + 1), dtype=jnp.float32)
+    onehot = onehot.at[rows, indices.reshape(-1)].add(mask.reshape(-1))
+    onehot = jnp.minimum(onehot[:, :num_cols], 1.0)  # binarize; drop sentinel
+    return acc + onehot.T @ other_onehot
+
+
+def _onehot_chunk(csr: PaddedCSR, start: int, end: int) -> np.ndarray:
+    chunk = end - start
+    out = np.zeros((chunk, csr.num_cols), dtype=np.float32)
+    idx = csr.indices[start:end]
+    msk = csr.mask[start:end] > 0
+    rows = np.repeat(np.arange(chunk), idx.shape[1])
+    valid = msk.reshape(-1) & (idx.reshape(-1) < csr.num_cols)
+    out[rows[valid], idx.reshape(-1)[valid]] = 1.0
+    return out
+
+
+def cooccurrence(
+    primary: PaddedCSR, other: PaddedCSR | None = None, chunk: int = 4096
+) -> np.ndarray:
+    """``A_primary^T @ A_other`` over shared user rows -> [items_p, items_o].
+
+    ``other=None`` means self-cooccurrence. Both CSRs must be row-indexed by
+    the same user universe (same num_rows).
+    """
+    other = other if other is not None else primary
+    if primary.num_rows != other.num_rows:
+        raise ValueError(
+            f"CSRs must share the user universe: {primary.num_rows} vs {other.num_rows}"
+        )
+    n_users = primary.num_rows
+    acc = jnp.zeros((primary.num_cols, other.num_cols), dtype=jnp.float32)
+    for start in range(0, n_users, chunk):
+        end = min(start + chunk, n_users)
+        acc = _accumulate_chunk(
+            jnp.asarray(primary.indices[start:end]),
+            jnp.asarray(primary.mask[start:end]),
+            jnp.asarray(_onehot_chunk(other, start, end)),
+            acc,
+            num_cols=primary.num_cols,
+        )
+    return np.asarray(acc)
+
+
+def distinct_user_counts(csr: PaddedCSR) -> np.ndarray:
+    """Per-item distinct-user count in O(nnz) on the host -- the diagonal of
+    the (binarized) self-cooccurrence, without the [items, items] matmul."""
+    rows = np.repeat(np.arange(csr.indices.shape[0]), csr.max_len)
+    cols = csr.indices.reshape(-1)
+    valid = (csr.mask.reshape(-1) > 0) & (cols < csr.num_cols)
+    pairs = np.unique(
+        rows[valid].astype(np.int64) * csr.num_cols + cols[valid].astype(np.int64)
+    )
+    return np.bincount(
+        (pairs % csr.num_cols).astype(np.int64), minlength=csr.num_cols
+    ).astype(np.float32)
+
+
+def _xlogx(x):
+    return jnp.where(x > 0, x * jnp.log(x), 0.0)
+
+
+@jax.jit
+def _llr_kernel(k11, row_totals, col_totals, total):
+    """G^2 log-likelihood-ratio over the 2x2 contingency per (i, j) pair."""
+    k12 = jnp.maximum(row_totals[:, None] - k11, 0.0)
+    k21 = jnp.maximum(col_totals[None, :] - k11, 0.0)
+    k22 = jnp.maximum(total - k11 - k12 - k21, 0.0)
+    h_k = _xlogx(k11) + _xlogx(k12) + _xlogx(k21) + _xlogx(k22)
+    h_rows = _xlogx(k11 + k12) + _xlogx(k21 + k22)
+    h_cols = _xlogx(k11 + k21) + _xlogx(k12 + k22)
+    h_total = _xlogx(k11 + k12 + k21 + k22)
+    llr = 2.0 * (h_k + h_total - h_rows - h_cols)
+    return jnp.where(k11 > 0, jnp.maximum(llr, 0.0), 0.0)
+
+
+def llr_scores(
+    cooc: np.ndarray,
+    row_totals: np.ndarray,
+    col_totals: np.ndarray,
+    total: float,
+) -> np.ndarray:
+    """LLR significance of each cooccurrence count (same shape as cooc)."""
+    return np.asarray(
+        _llr_kernel(
+            jnp.asarray(cooc, dtype=jnp.float32),
+            jnp.asarray(row_totals, dtype=jnp.float32),
+            jnp.asarray(col_totals, dtype=jnp.float32),
+            float(total),
+        )
+    )
+
+
+def top_k_sparsify(matrix: np.ndarray, k: int, drop_diagonal: bool = True):
+    """Keep the top-k entries per ROW -> (indices [n, k], values [n, k]).
+
+    The serving-side 'indicator' form (reference UR keeps top-N correlators
+    per item in Elasticsearch)."""
+    m = matrix.copy()
+    if drop_diagonal and m.shape[0] == m.shape[1]:
+        np.fill_diagonal(m, -np.inf)
+    k = min(k, m.shape[1])
+    idx = np.argpartition(-m, k - 1, axis=1)[:, :k]
+    vals = np.take_along_axis(m, idx, axis=1)
+    order = np.argsort(-vals, axis=1)
+    idx = np.take_along_axis(idx, order, axis=1)
+    vals = np.take_along_axis(vals, order, axis=1)
+    vals = np.where(np.isfinite(vals), vals, 0.0)
+    return idx.astype(np.int32), vals.astype(np.float32)

@@ -1,0 +1,133 @@
+"""Classification template tests (BASELINE config #2: SMS-spam shape)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.models.classification import engine_factory
+from predictionio_tpu.ops.classify import train_naive_bayes, train_logistic_regression
+from predictionio_tpu.ops.features import BinaryVectorizer, hashing_vectorize, tokenize
+from predictionio_tpu.workflow.context import RuntimeContext
+
+SPAM = ["win cash now", "free prize claim now", "win free entry", "cash prize winner",
+        "claim your free cash", "urgent prize waiting"]
+HAM = ["see you at lunch", "meeting moved to monday", "call me when home",
+       "lunch tomorrow?", "are you coming home", "the meeting is at noon"]
+
+
+@pytest.fixture()
+def sms_app(storage_env):
+    app_id = storage_env.get_meta_data_apps().insert(App(name="SmsApp"))
+    le = storage_env.get_l_events()
+    le.init_channel(app_id)
+    events = []
+    for k, texts in (("spam", SPAM), ("ham", HAM)):
+        for i, t in enumerate(texts):
+            events.append(
+                Event(event="train", entity_type="message", entity_id=f"{k}{i}",
+                      properties=DataMap({"text": t, "label": k}))
+            )
+    le.batch_insert(events, app_id=app_id)
+    return app_id
+
+
+def params(algo, **p):
+    return EngineParams.from_json_obj(
+        {"datasource": {"params": {"appName": "SmsApp"}},
+         "algorithms": [{"name": algo, "params": p}]}
+    )
+
+
+class TestKernels:
+    def test_tokenize_and_hashing(self):
+        assert tokenize("Win CASH now!") == ["win", "cash", "now"]
+        x = hashing_vectorize(["a b a", "c"], dim=32)
+        assert x.shape == (2, 32)
+        assert x[0].sum() == 3 and x[1].sum() == 1
+
+    def test_binary_vectorizer(self):
+        v = BinaryVectorizer.fit([{"plan": "a"}, {"plan": "b"}], ["plan"])
+        x = v.transform([{"plan": "b"}, {"plan": "zz"}])
+        assert x[0].sum() == 1 and x[1].sum() == 0
+
+    def test_naive_bayes_separates_class_conditionals(self):
+        # class 0 emits feature 0, class 1 emits feature 1 (multinomial NB's
+        # home turf; AND-style interactions are intentionally not learnable)
+        x = np.array([[3, 1], [1, 3]] * 20, dtype=np.float32)
+        y = np.array([0, 1] * 20, dtype=np.int32)
+        m = train_naive_bayes(x, y, 2)
+        assert m.scores(np.array([[4.0, 0.0]]))[0].argmax() == 0
+        assert m.scores(np.array([[0.0, 4.0]]))[0].argmax() == 1
+
+    def test_logreg_linearly_separable(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] > 0).astype(np.int32)
+        m = train_logistic_regression(x, y, 2, iterations=60)
+        acc = (m.scores(x).argmax(axis=1) == y).mean()
+        assert acc > 0.95
+
+
+class TestClassificationEngine:
+    @pytest.mark.parametrize("algo", ["naive-bayes", "logistic-regression"])
+    def test_text_mode_spam(self, sms_app, algo):
+        engine = engine_factory()
+        ctx = RuntimeContext()
+        ep = params(algo, iterations=60)
+        models = engine.train(ctx, ep)
+        a = engine._algorithms(ep)[0]
+        spam = a.predict(models[0], {"text": "free cash prize now"})
+        ham = a.predict(models[0], {"text": "see you at the meeting"})
+        assert spam["label"] == "spam", spam
+        assert ham["label"] == "ham", ham
+        assert 1.0 >= spam["scores"]["spam"] > 0.5
+        assert spam["scores"]["spam"] + spam["scores"]["ham"] == pytest.approx(1.0)
+        with pytest.raises(ValueError):
+            a.predict(models[0], {"nope": 1})
+
+    def test_properties_mode(self, storage_env):
+        app_id = storage_env.get_meta_data_apps().insert(App(name="PropApp"))
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        events = []
+        for i in range(30):
+            voice = i % 2
+            events.append(
+                Event(event="$set", entity_type="user", entity_id=f"u{i}",
+                      properties=DataMap({
+                          "voice": voice, "sms": 1 - voice,
+                          "plan": "talk" if voice else "data",
+                      }))
+            )
+        le.batch_insert(events, app_id=app_id)
+        ep = EngineParams.from_json_obj(
+            {"datasource": {"params": {"appName": "PropApp", "mode": "properties",
+                                       "labelField": "plan"}},
+             "algorithms": [{"name": "naive-bayes", "params": {}}]}
+        )
+        engine = engine_factory()
+        models = engine.train(RuntimeContext(), ep)
+        a = engine._algorithms(ep)[0]
+        out = a.predict(models[0], {"features": {"voice": 1, "sms": 0}})
+        assert out["label"] == "talk"
+
+    def test_eval_accuracy(self, sms_app):
+        from predictionio_tpu.controller.metrics import (
+            EngineParamsGenerator,
+            Evaluation,
+            AverageMetric,
+        )
+        from predictionio_tpu.workflow.core_workflow import run_evaluation
+        import json
+
+        def accuracy(ei, q, p, a):
+            return 1.0 if p["label"] == a else 0.0
+
+        inst = run_evaluation(
+            Evaluation(engine=engine_factory(), metric=AverageMetric(score=accuracy)),
+            EngineParamsGenerator([params("naive-bayes")]),
+        )
+        results = json.loads(inst.evaluator_results_json)
+        assert results["bestScore"] >= 0.8

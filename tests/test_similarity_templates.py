@@ -1,0 +1,171 @@
+"""Similar-product + Universal Recommender template tests (BASELINE configs
+#3/#4), plus cooccurrence/LLR kernel checks."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.ops.cooccurrence import (
+    cooccurrence,
+    llr_scores,
+    top_k_sparsify,
+)
+from predictionio_tpu.ops.ragged import pack_padded_csr
+from predictionio_tpu.workflow.context import RuntimeContext
+
+
+class TestCooccurrenceKernels:
+    def test_cooccurrence_matches_dense(self):
+        rng = np.random.default_rng(0)
+        n_u, n_i = 50, 12
+        dense = (rng.random((n_u, n_i)) < 0.3).astype(np.float32)
+        uu, ii = np.nonzero(dense)
+        csr = pack_padded_csr(uu, ii, np.ones(len(uu), np.float32), n_u, n_i)
+        got = cooccurrence(csr, chunk=16)
+        np.testing.assert_allclose(got, dense.T @ dense, atol=1e-4)
+
+    def test_cross_occurrence(self):
+        # users 0,1 buy item 0; users 0,1,2 view item 1 -> cooc[0,1] = 2
+        buy = pack_padded_csr(np.array([0, 1]), np.array([0, 0]),
+                              np.ones(2, np.float32), 4, 3)
+        view = pack_padded_csr(np.array([0, 1, 2]), np.array([1, 1, 1]),
+                               np.ones(3, np.float32), 4, 3)
+        cooc = cooccurrence(buy, view)
+        assert cooc[0, 1] == 2.0
+        assert cooc[0, 0] == 0.0
+
+    def test_llr_favors_specific_over_popular(self):
+        # item pair (0,1): perfectly correlated among 4 users out of 100;
+        # pair (0,2): item 2 is popular everywhere (no information)
+        cooc = np.array([[4.0, 4.0, 4.0]])
+        row_totals = np.array([4.0])
+        col_totals = np.array([4.0, 4.0, 100.0])
+        llr = llr_scores(cooc, row_totals, col_totals, total=100)
+        assert llr[0, 1] > llr[0, 2]
+        assert llr[0, 2] == pytest.approx(0.0, abs=1e-3)  # independent
+
+    def test_top_k_sparsify(self):
+        m = np.array([[0.0, 3.0, 1.0, 2.0], [5.0, 0.0, 0.0, 0.0]], dtype=np.float32)
+        idx, vals = top_k_sparsify(m, 2, drop_diagonal=False)
+        assert list(idx[0]) == [1, 3] and list(vals[0]) == [3.0, 2.0]
+
+
+def seed_store_events(storage_env, app_name):
+    """Two cliques; 'buy' is sparse conversion, 'view' is dense browsing."""
+    app_id = storage_env.get_meta_data_apps().insert(App(name=app_name))
+    le = storage_env.get_l_events()
+    le.init_channel(app_id)
+    rng = np.random.default_rng(3)
+    events = []
+    for u in range(24):
+        clique = u % 2
+        base = clique * 5  # items c0: i0-i4, c1: i5-i9
+        viewed = rng.choice(5, size=3, replace=False) + base
+        for i in viewed:
+            events.append(("view", f"u{u}", f"i{i}"))
+        events.append(("buy", f"u{u}", f"i{int(rng.choice(viewed))}"))
+    # item properties for UR business rules
+    prop_events = [
+        Event(event="$set", entity_type="item", entity_id=f"i{i}",
+              properties=DataMap({"category": "odd" if i % 2 else "even"}))
+        for i in range(10)
+    ]
+    le.batch_insert(
+        [
+            Event(event=n, entity_type="user", entity_id=u,
+                  target_entity_type="item", target_entity_id=i)
+            for n, u, i in events
+        ] + prop_events,
+        app_id=app_id,
+    )
+    return app_id
+
+
+class TestSimilarProduct:
+    def test_similar_items_stay_in_clique(self, storage_env):
+        from predictionio_tpu.models.similarproduct import engine_factory
+
+        seed_store_events(storage_env, "Shop")
+        ep = EngineParams.from_json_obj(
+            {"datasource": {"params": {"appName": "Shop"}},
+             "algorithms": [{"name": "cooccurrence", "params": {"chunk": 8}}]}
+        )
+        engine = engine_factory()
+        models = engine.train(RuntimeContext(), ep)
+        a = engine._algorithms(ep)[0]
+        out = a.predict(models[0], {"items": ["i1"], "num": 3})
+        items = [s["item"] for s in out["itemScores"]]
+        assert items, "no similar items returned"
+        assert all(int(i[1:]) < 5 for i in items), items
+        assert "i1" not in items
+        # user-anchored query + blacklist
+        out2 = a.predict(models[0], {"user": "u0", "num": 5, "blackList": items[:1]})
+        assert items[0] not in [s["item"] for s in out2["itemScores"]]
+        assert a.predict(models[0], {"items": ["zzz"]}) == {"itemScores": []}
+
+    def test_eval_pairs_shape(self, storage_env):
+        from predictionio_tpu.models.similarproduct import SimilarProductDataSource
+
+        seed_store_events(storage_env, "Shop2")
+        ds = SimilarProductDataSource({"appName": "Shop2"})
+        folds = ds.read_eval(RuntimeContext())
+        assert len(folds) == 1
+        train, info, pairs = folds[0]
+        assert pairs and all("items" in q for q, _ in pairs)
+
+
+class TestUniversalRecommender:
+    def test_multi_event_recommendation(self, storage_env):
+        from predictionio_tpu.models.universal import engine_factory
+
+        seed_store_events(storage_env, "URShop")
+        ep = EngineParams.from_json_obj(
+            {"datasource": {"params": {"appName": "URShop",
+                                       "eventNames": ["buy", "view"]}},
+             "algorithms": [{"name": "ur", "params": {"chunk": 8}}]}
+        )
+        engine = engine_factory()
+        models = engine.train(RuntimeContext(), ep)
+        a = engine._algorithms(ep)[0]
+        out = a.predict(models[0], {"user": "u0", "num": 3})
+        items = [s["item"] for s in out["itemScores"]]
+        assert items, "no recommendations"
+        assert all(int(i[1:]) < 5 for i in items), items  # u0 is clique 0
+
+        # cold user -> empty; item-anchored works
+        assert a.predict(models[0], {"user": "nobody"}) == {"itemScores": []}
+        anchored = a.predict(models[0], {"items": ["i6"], "num": 3})
+        assert all(int(s["item"][1:]) >= 5 for s in anchored["itemScores"])
+
+    def test_business_rules_filter_and_boost(self, storage_env):
+        from predictionio_tpu.models.universal import engine_factory
+
+        seed_store_events(storage_env, "URShop2")
+        ep = EngineParams.from_json_obj(
+            {"datasource": {"params": {"appName": "URShop2",
+                                       "eventNames": ["buy", "view"]}},
+             "algorithms": [{"name": "ur", "params": {"chunk": 8}}]}
+        )
+        engine = engine_factory()
+        models = engine.train(RuntimeContext(), ep)
+        a = engine._algorithms(ep)[0]
+        flt = a.predict(
+            models[0],
+            {"user": "u0", "num": 5,
+             "fields": [{"name": "category", "values": ["even"], "bias": -1}]},
+        )
+        assert all(int(s["item"][1:]) % 2 == 0 for s in flt["itemScores"])
+        # boost reorders without filtering: if the base ranking contains an
+        # odd item at all, a huge odd boost must put one first
+        base = a.predict(models[0], {"user": "u2", "num": 5})
+        base_parities = {int(s["item"][1:]) % 2 for s in base["itemScores"]}
+        boost = a.predict(
+            models[0],
+            {"user": "u2", "num": 5,
+             "fields": [{"name": "category", "values": ["odd"], "bias": 100.0}]},
+        )
+        assert len(boost["itemScores"]) == len(base["itemScores"])  # no filtering
+        if 1 in base_parities:
+            assert int(boost["itemScores"][0]["item"][1:]) % 2 == 1
