@@ -1,0 +1,19 @@
+"""Neural Collaborative Filtering template (NeuMF: GMF + MLP).
+
+BASELINE.json config #5: "MLP matrix factorization as Pallas kernel on TPU
+mesh" -- the one template with NO reference counterpart (the reference
+predates neural recommenders; SURVEY.md section 2.6 flags embedding-table TP
+as the natural extension). Design:
+
+- flax model: GMF (elementwise product of user/item embeddings) + MLP tower
+  over the concat, fused into one score (NeuMF, He et al. 2017 shape);
+- training: optax Adam, jitted step over the ('data', 'model') mesh -- batch
+  sharded over data, embedding + hidden dims sharded over model (tensor
+  parallelism of the tables);
+- serving: a Pallas kernel scores ALL items for one user in a single fused
+  pass (gather-free broadcast + both branches + top-k on host).
+"""
+
+from predictionio_tpu.models.ncf.engine import NCFAlgorithm, engine_factory
+
+__all__ = ["NCFAlgorithm", "engine_factory"]

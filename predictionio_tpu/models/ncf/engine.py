@@ -1,0 +1,135 @@
+"""DASE components of the Neural-CF template.
+
+Query contract matches the recommendation template:
+``{"user": "u1", "num": 4}`` -> ``{"itemScores": [...]}``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import Engine, FirstServing, TPUAlgorithm
+from predictionio_tpu.models.ncf.kernel import (
+    ncf_score_all_items,
+    reference_score_all_items,
+)
+from predictionio_tpu.models.ncf.model import (
+    NCFConfig,
+    make_implicit_batches,
+    train_ncf,
+)
+from predictionio_tpu.models.recommendation.engine import (
+    RatingsData,
+    RecommendationDataSource,
+)
+from predictionio_tpu.controller.base import Preparator
+
+
+class NCFPreparator(Preparator):
+    """NCF consumes the COO directly; no CSR packing needed."""
+
+    def prepare(self, ctx, training_data: RatingsData):
+        return training_data
+
+
+@dataclass
+class NCFModel:
+    params: dict
+    user_index: dict[str, int]
+    item_ids: list[str]
+    item_index: dict[str, int]
+    seen: dict[int, set[int]]
+    use_pallas: bool
+
+
+class NCFAlgorithm(TPUAlgorithm):
+    """Params: embedDim, hidden, learningRate, epochs, batchSize, implicit,
+    negatives, seed, usePallas (serving kernel; auto-off on CPU)."""
+
+    def train(self, ctx, data: RatingsData) -> NCFModel:
+        import jax
+
+        p = self.params
+        config = NCFConfig(
+            num_users=data.num_users,
+            num_items=data.num_items,
+            embed_dim=p.get_or("embedDim", 32),
+            hidden=tuple(p.get_or("hidden", [64, 32])),
+            learning_rate=p.get_or("learningRate", 0.01),
+            implicit=p.get_or("implicit", False),
+            negatives=p.get_or("negatives", 4),
+            batch_size=p.get_or("batchSize", 4096),
+            epochs=p.get_or("epochs", 5),
+            seed=p.get_or("seed", 0),
+        )
+        users, items, labels = data.users, data.items, data.ratings
+        if config.implicit:
+            users, items, labels = make_implicit_batches(
+                users, items, data.num_items, config.negatives,
+                np.random.default_rng(config.seed),
+            )
+        checkpoint = None
+        if p.get_or("checkpoint", False):
+            from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+            # key on the engine-instance id when the workflow provides one;
+            # programmatic callers get a params-stable key
+            run_id = getattr(ctx, "instance_id", None) or f"seed{config.seed}"
+            checkpoint = CheckpointManager(f"ncf-{run_id}")
+        params, _ = train_ncf(
+            config, users, items, labels, ctx.mesh, checkpoint=checkpoint
+        )
+        seen: dict[int, set[int]] = {}
+        for u, i in zip(data.users, data.items):
+            seen.setdefault(int(u), set()).add(int(i))
+        backend = jax.devices()[0].platform
+        return NCFModel(
+            params=params,
+            user_index={uid: j for j, uid in enumerate(data.user_ids)},
+            item_ids=data.item_ids,
+            item_index={iid: j for j, iid in enumerate(data.item_ids)},
+            seen=seen,
+            use_pallas=p.get_or("usePallas", backend not in ("cpu",)),
+        )
+
+    def predict(self, model: NCFModel, query) -> dict:
+        num = int(query.get("num", 10))
+        user_idx = model.user_index.get(str(query.get("user")))
+        if user_idx is None:
+            return {"itemScores": []}
+        n_items = len(model.item_ids)
+        if model.use_pallas:
+            scores = ncf_score_all_items(
+                model.params, user_idx, n_items, interpret=False
+            )
+        else:
+            scores = reference_score_all_items(model.params, user_idx, n_items)
+        exclude = {
+            model.item_index[str(b)]
+            for b in (query.get("blackList") or [])
+            if str(b) in model.item_index
+        }
+        if query.get("unseenOnly", True):
+            exclude |= model.seen.get(user_idx, set())
+        scores = scores.astype(np.float64)
+        for j in exclude:
+            scores[j] = -np.inf
+        order = np.argsort(-scores)[:num]
+        return {
+            "itemScores": [
+                {"item": model.item_ids[j], "score": float(scores[j])}
+                for j in order
+                if np.isfinite(scores[j])
+            ]
+        }
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=RecommendationDataSource,
+        preparator_class=NCFPreparator,
+        algorithm_class_map={"ncf": NCFAlgorithm},
+        serving_class=FirstServing,
+    )

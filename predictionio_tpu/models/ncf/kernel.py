@@ -1,0 +1,146 @@
+"""Pallas kernel: fused all-items NeuMF scoring for one user.
+
+The serving hot path scores EVERY item for a user (then top-k). Done naively
+that is four HBM-bound passes (gmf mult, concat, two dense layers). This
+kernel fuses the whole NeuMF head over item tiles resident in VMEM:
+
+    score[i] = w_out . [gmf_u * gmf_item[i] ; mlp(mlp_u ++ mlp_item[i])]
+
+Item embedding tables stream through VMEM in (TILE_I, E) blocks; the user's
+vectors and the MLP weights (small) are broadcast to every grid step. One
+HBM read of the tables per query -> bandwidth-bound at the theoretical
+minimum. On CPU test backends the kernel runs in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+TILE_I = 512
+
+
+def _ncf_score_kernel(
+    gmf_item_ref,  # [TILE_I, E]
+    mlp_item_ref,  # [TILE_I, E]
+    gmf_user_ref,  # [1, E]
+    mlp_user_ref,  # [1, E]
+    w0u_ref,       # [E, H0]   (user half of the first MLP kernel)
+    w0i_ref,       # [E, H0]   (item half)
+    b0_ref,        # [1, H0]
+    w1_ref,        # [H0, H1]
+    b1_ref,        # [1, H1]
+    wog_ref,       # [1, E]    (output weights, gmf part)
+    woh_ref,       # [1, H1]   (output weights, mlp part)
+    bo_ref,        # [1, 1]
+    out_ref,       # [TILE_I]
+):
+    gmf = gmf_item_ref[:] * gmf_user_ref[0][None, :]
+    # first dense over the concat == split matmul (avoids concat in VMEM)
+    h = (
+        mlp_user_ref[:] @ w0u_ref[:]
+        + mlp_item_ref[:] @ w0i_ref[:]
+        + b0_ref[0][None, :]
+    )
+    h = jnp.maximum(h, 0.0)
+    h = jnp.maximum(h @ w1_ref[:] + b1_ref[0][None, :], 0.0)
+    # final projections as multiply+reduce (VPU) -- a [., 1] matmul would
+    # fight the 128-lane tiling for no gain
+    score = (
+        jnp.sum(gmf * wog_ref[0][None, :], axis=1)
+        + jnp.sum(h * woh_ref[0][None, :], axis=1)
+        + bo_ref[0, 0]
+    )
+    out_ref[:] = score
+
+
+def _mlp_depth(params) -> int:
+    return len([k for k in params if k.startswith("mlp_") and k[4:].isdigit()])
+
+
+def ncf_score_all_items(params, user_index: int, num_items: int, interpret: bool):
+    """Score all items for one user via the fused kernel. Host-callable.
+
+    The kernel is specialized to the default 2-hidden-layer tower; other
+    depths fall back to the (XLA-fused anyway) reference head.
+    """
+    if _mlp_depth(params) != 2:
+        return reference_score_all_items(params, user_index, num_items)
+    e = params["gmf_user"]["embedding"].shape[1]
+    h0 = params["mlp_0"]["kernel"].shape[1]
+    h1 = params["mlp_1"]["kernel"].shape[1]
+
+    gmf_items = np.asarray(params["gmf_item"]["embedding"], np.float32)
+    mlp_items = np.asarray(params["mlp_item"]["embedding"], np.float32)
+    padded = ((num_items + TILE_I - 1) // TILE_I) * TILE_I
+    if padded != gmf_items.shape[0]:
+        pad = padded - gmf_items.shape[0]
+        gmf_items = np.pad(gmf_items, ((0, pad), (0, 0)))
+        mlp_items = np.pad(mlp_items, ((0, pad), (0, 0)))
+
+    w0 = np.asarray(params["mlp_0"]["kernel"], np.float32)   # [2E, H0]
+    out_w = np.asarray(params["out"]["kernel"], np.float32)  # [E+H1, 1]
+    args = (
+        jnp.asarray(gmf_items),
+        jnp.asarray(mlp_items),
+        jnp.asarray(params["gmf_user"]["embedding"][user_index], np.float32)[None, :],
+        jnp.asarray(params["mlp_user"]["embedding"][user_index], np.float32)[None, :],
+        jnp.asarray(w0[:e]),
+        jnp.asarray(w0[e:]),
+        jnp.asarray(params["mlp_0"]["bias"], np.float32)[None, :],
+        jnp.asarray(params["mlp_1"]["kernel"], np.float32),
+        jnp.asarray(params["mlp_1"]["bias"], np.float32)[None, :],
+        jnp.asarray(out_w[:e, 0])[None, :],
+        jnp.asarray(out_w[e:, 0])[None, :],
+        jnp.asarray(params["out"]["bias"], np.float32).reshape(1, 1),
+    )
+
+    grid = padded // TILE_I
+    tile_spec = lambda: pl.BlockSpec((TILE_I, e), lambda i: (i, 0))
+    rep = lambda r, c: pl.BlockSpec((r, c), lambda i: (0, 0))
+    scores = pl.pallas_call(
+        _ncf_score_kernel,
+        out_shape=jax.ShapeDtypeStruct((padded,), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            tile_spec(),
+            tile_spec(),
+            rep(1, e),
+            rep(1, e),
+            rep(e, h0),
+            rep(e, h0),
+            rep(1, h0),
+            rep(h0, h1),
+            rep(1, h1),
+            rep(1, e),
+            rep(1, h1),
+            rep(1, 1),
+        ],
+        out_specs=pl.BlockSpec((TILE_I,), lambda i: (i,)),
+        interpret=interpret,
+    )(*args)
+    return np.asarray(scores)[:num_items]
+
+
+def reference_score_all_items(params, user_index: int, num_items: int) -> np.ndarray:
+    """Plain-numpy NeuMF head for ANY tower depth (kernel oracle + CPU path)."""
+    gmf_u = np.asarray(params["gmf_user"]["embedding"][user_index])
+    mlp_u = np.asarray(params["mlp_user"]["embedding"][user_index])
+    gmf_i = np.asarray(params["gmf_item"]["embedding"][:num_items])
+    mlp_i = np.asarray(params["mlp_item"]["embedding"][:num_items])
+    gmf = gmf_i * gmf_u
+    h = np.concatenate([np.broadcast_to(mlp_u, mlp_i.shape), mlp_i], axis=1)
+    for layer in range(_mlp_depth(params)):
+        h = np.maximum(
+            h @ np.asarray(params[f"mlp_{layer}"]["kernel"])
+            + np.asarray(params[f"mlp_{layer}"]["bias"]),
+            0.0,
+        )
+    fused = np.concatenate([gmf, h], axis=1)
+    return (
+        fused @ np.asarray(params["out"]["kernel"]) + np.asarray(params["out"]["bias"])
+    )[:, 0]

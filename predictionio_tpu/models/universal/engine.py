@@ -132,11 +132,24 @@ class URModel:
     event_names: list[str]
     item_ids: list[str]
     item_index: dict[str, int]
-    #: per event type: (top_indices [items_t?, k]... keyed by anchor item)
-    indicators: dict[str, tuple[np.ndarray, np.ndarray]]
+    #: per event type: reverse indicator index -- history item j ->
+    #: [(primary item p, weight)] (inverted from the per-p top-k table so a
+    #: query costs O(history * hits), not O(history * items * k))
+    indicators: dict[str, dict[int, list[tuple[int, float]]]]
     #: user id -> {event type -> [item indices]}
     user_history: dict[str, dict[str, list[int]]]
     item_properties: dict[str, dict]
+
+
+def _invert_indicators(
+    idx: np.ndarray, vals: np.ndarray
+) -> dict[int, list[tuple[int, float]]]:
+    inverted: dict[int, list[tuple[int, float]]] = {}
+    for p in range(idx.shape[0]):
+        for j, v in zip(idx[p], vals[p]):
+            if v > 0:
+                inverted.setdefault(int(j), []).append((p, float(v)))
+    return inverted
 
 
 class URAlgorithm(TPUAlgorithm):
@@ -175,8 +188,10 @@ class URAlgorithm(TPUAlgorithm):
                 else distinct_user_counts(csr)
             )
             llr = llr_scores(cooc, primary_counts, col_counts, total=n_users)
-            indicators[name] = top_k_sparsify(
-                llr, top_k, drop_diagonal=(name == data.event_names[0])
+            indicators[name] = _invert_indicators(
+                *top_k_sparsify(
+                    llr, top_k, drop_diagonal=(name == data.event_names[0])
+                )
             )
         history: dict[str, dict[str, list[int]]] = {}
         for name in data.event_names:
@@ -209,20 +224,16 @@ class URAlgorithm(TPUAlgorithm):
             )
         if not history:
             return {"itemScores": []}
-        # indicators[name] keeps, per PRIMARY item p, its top-k correlated
-        # type-t items: score(p) = sum of weights where p's top-k contains a
-        # history item (CCO scoring, O(items * k) per history item)
+        # CCO scoring via the reverse index: each history item j credits the
+        # primary items whose top-k correlators include j
         scores = np.zeros(len(model.item_ids), dtype=np.float64)
         for name, items in history.items():
-            ind = model.indicators.get(name)
-            if ind is None:
+            inverted = model.indicators.get(name)
+            if inverted is None:
                 continue
-            idx, vals = ind
-            # find rows whose top-k contains the user's history items:
-            # row p gets credit when any history item j appears in idx[p]
             for j in set(items):
-                hits = idx == j
-                scores += (vals * hits).sum(axis=1)
+                for p, v in inverted.get(j, ()):
+                    scores[p] += v
         exclude = {
             j
             for items in history.values()

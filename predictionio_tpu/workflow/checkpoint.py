@@ -1,0 +1,56 @@
+"""Step checkpointing: preemption safety for long TPU training runs.
+
+The reference has NO mid-training checkpoints -- Spark lineage was its
+failure story and models persist only on completion (SURVEY.md section 5.3/
+5.4). On TPU, preemption safety must come from explicit step checkpoints:
+orbax writes ``{step, params, opt_state}``; ``latest_step`` lets a re-run
+``pio train`` resume instead of restarting.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+logger = logging.getLogger("pio.checkpoint")
+
+
+class CheckpointManager:
+    """Thin orbax wrapper keyed by engine-instance/run id."""
+
+    def __init__(self, run_id: str, base_dir: str | None = None, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        base = base_dir or os.path.join(
+            os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store")),
+            "checkpoints",
+        )
+        self.path = os.path.abspath(os.path.join(base, run_id))
+        os.makedirs(self.path, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.path,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._manager.save(step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> int | None:
+        return self._manager.latest_step()
+
+    def restore(self, state_template: Any, step: int | None = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self._manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.path}")
+        return self._manager.restore(
+            step, args=ocp.args.StandardRestore(state_template)
+        )
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()

@@ -40,8 +40,15 @@ class RuntimeContext:
     so storage/CLI paths stay fast.
     """
 
-    def __init__(self, runtime_conf: Mapping[str, Any] | None = None):
+    def __init__(
+        self,
+        runtime_conf: Mapping[str, Any] | None = None,
+        instance_id: str | None = None,
+    ):
         self.runtime_conf: dict[str, Any] = dict(runtime_conf or {})
+        #: engine-instance id of the current run (set by the train workflow;
+        #: algorithms key step checkpoints on it)
+        self.instance_id = instance_id
         self._mesh = None
 
     # -- mesh construction --------------------------------------------------

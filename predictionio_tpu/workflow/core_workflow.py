@@ -75,7 +75,7 @@ def run_train(
         serving_params=json.dumps(dict(engine_params.serving_params)),
     )
     instance_id = instances.insert(instance)
-    ctx = RuntimeContext(variant.runtime_conf)
+    ctx = RuntimeContext(variant.runtime_conf, instance_id=instance_id)
     try:
         models = engine.train(
             ctx, engine_params, skip_sanity_check=workflow_params.skip_sanity_check
