@@ -117,12 +117,20 @@ class Engine:
         engine_params: EngineParams,
         skip_sanity_check: bool = False,
     ) -> list[Any]:
+        import time
+
+        timings = getattr(ctx, "timings", {})
+
+        t0 = time.perf_counter()
         data_source = self.data_source_class(engine_params.data_source_params)
         training_data = data_source.read_training(ctx)
+        timings["read"] = time.perf_counter() - t0
         self._maybe_sanity_check("training data", training_data, skip_sanity_check)
 
+        t0 = time.perf_counter()
         preparator = self.preparator_class(engine_params.preparator_params)
         prepared_data = preparator.prepare(ctx, training_data)
+        timings["prepare"] = time.perf_counter() - t0
         self._maybe_sanity_check("prepared data", prepared_data, skip_sanity_check)
 
         models = []
@@ -130,9 +138,16 @@ class Engine:
             self._algorithms(engine_params), engine_params.algorithm_params_list
         ):
             logger.info("training algorithm %r (%s)", name, component_name(algorithm))
+            t0 = time.perf_counter()
             model = algorithm.train(ctx, prepared_data)
+            timings[f"train[{name}]"] = time.perf_counter() - t0
             self._maybe_sanity_check(f"model[{name}]", model, skip_sanity_check)
             models.append(model)
+        if timings:
+            logger.info(
+                "stage timings: %s",
+                ", ".join(f"{k}={v:.3f}s" for k, v in timings.items()),
+            )
         return models
 
     # -- serialization + deploy rehydration ---------------------------------

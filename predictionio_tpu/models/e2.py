@@ -1,0 +1,107 @@
+"""e2: self-contained reference algorithms + evaluation helpers.
+
+Parity role of the reference ``e2/`` module (apache/predictionio layout,
+unverified -- SURVEY.md section 2.5 #36): small building blocks templates and
+tests compose. ``PythonEngine``'s role (run Python algos under the JVM) is
+moot here -- the whole framework is Python; any callable works as a DASE
+component.
+
+- :func:`categorical_naive_bayes` -- NB over string-valued feature dicts
+  (reference CategoricalNaiveBayes), via BinaryVectorizer + the MXU NB.
+- :class:`MarkovChain` -- first-order transition model with additive
+  smoothing (reference MarkovChain), trained as one one-hot matmul.
+- :func:`cross_validation_folds` -- generic k-fold splitter (reference
+  e2.evaluation.CrossValidation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.ops.classify import NaiveBayesModel, train_naive_bayes
+from predictionio_tpu.ops.features import BinaryVectorizer
+
+
+@dataclass
+class CategoricalNBModel:
+    vectorizer: BinaryVectorizer
+    classes: list[str]
+    inner: NaiveBayesModel
+
+    def predict(self, record: dict) -> str:
+        x = self.vectorizer.transform([record])
+        return self.classes[int(self.inner.scores(x)[0].argmax())]
+
+    def log_score(self, record: dict, label: str) -> float:
+        x = self.vectorizer.transform([record])
+        return float(self.inner.scores(x)[0][self.classes.index(label)])
+
+
+def categorical_naive_bayes(
+    records: list[dict], labels: list[str], smoothing: float = 1.0
+) -> CategoricalNBModel:
+    fields = sorted({k for r in records for k in r})
+    vectorizer = BinaryVectorizer.fit(records, fields)
+    classes = sorted(set(labels))
+    index = {c: i for i, c in enumerate(classes)}
+    y = np.array([index[l] for l in labels], dtype=np.int32)
+    inner = train_naive_bayes(
+        vectorizer.transform(records), y, len(classes), smoothing=smoothing
+    )
+    return CategoricalNBModel(vectorizer=vectorizer, classes=classes, inner=inner)
+
+
+@dataclass
+class MarkovChain:
+    """First-order Markov chain over an integer state space."""
+
+    transition: np.ndarray  # [S, S] row-stochastic
+    states: list[str]
+
+    @classmethod
+    def fit(cls, sequences: list[list[str]], smoothing: float = 1e-3) -> "MarkovChain":
+        state_index: dict[str, int] = {}
+        pairs: list[tuple[int, int]] = []
+        for seq in sequences:
+            idx = [state_index.setdefault(s, len(state_index)) for s in seq]
+            pairs.extend(zip(idx[:-1], idx[1:]))
+        n = len(state_index)
+        if n == 0:
+            raise ValueError("no states in training sequences")
+        counts = np.zeros((n, n))
+        if pairs:
+            src = np.array([p[0] for p in pairs])
+            dst = np.array([p[1] for p in pairs])
+            # O(P) scatter-add; a one-hot matmul here would materialize
+            # [P, S] dense intermediates for no benefit at host scale
+            np.add.at(counts, (src, dst), 1.0)
+        counts = counts + smoothing
+        transition = counts / counts.sum(axis=1, keepdims=True)
+        return cls(transition=transition, states=list(state_index))
+
+    def next_distribution(self, state: str) -> dict[str, float]:
+        i = self.states.index(state)
+        return dict(zip(self.states, self.transition[i].tolist()))
+
+    def most_likely_next(self, state: str) -> str:
+        i = self.states.index(state)
+        return self.states[int(self.transition[i].argmax())]
+
+    def sequence_log_prob(self, seq: list[str]) -> float:
+        total = 0.0
+        for a, b in zip(seq[:-1], seq[1:]):
+            i, j = self.states.index(a), self.states.index(b)
+            total += float(np.log(self.transition[i, j]))
+        return total
+
+
+def cross_validation_folds(n: int, k: int, seed: int = 0):
+    """Yield (train_indices, test_indices) for k shuffled folds."""
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(n)
+    for f in range(k):
+        test = order[f::k]
+        train = np.setdiff1d(order, test)
+        yield train, test

@@ -79,18 +79,16 @@ def _require_app(name: str) -> App:
 
 
 def cmd_app_new(args: argparse.Namespace) -> int:
-    apps = storage.get_meta_data_apps()
-    if apps.get_by_name(args.name) is not None:
-        print(f"Error: app {args.name!r} already exists.")
+    from predictionio_tpu.tools.app_ops import create_app
+
+    try:
+        app, key = create_app(args.name, args.description, args.access_key)
+    except ValueError as exc:
+        print(f"Error: {exc}.")
         return 1
-    app_id = apps.insert(App(name=args.name, description=args.description))
-    storage.get_l_events().init_channel(app_id)
-    key = storage.get_meta_data_access_keys().insert(
-        AccessKey(key=args.access_key, app_id=app_id)
-    )
     print("App created:")
     print(f"  Name: {args.name}")
-    print(f"  ID: {app_id}")
+    print(f"  ID: {app.id}")
     print(f"  Access Key: {key}")
     return 0
 
@@ -119,48 +117,33 @@ def cmd_app_show(args: argparse.Namespace) -> int:
 
 
 def cmd_app_delete(args: argparse.Namespace) -> int:
+    from predictionio_tpu.tools.app_ops import delete_app_cascade
+
     app = _require_app(args.name)
     if not args.force:
         confirm = input(f"Delete app {app.name!r} and ALL its data? (YES to confirm): ")
         if confirm != "YES":
             print("Aborted.")
             return 1
-    le = storage.get_l_events()
-    channels = storage.get_meta_data_channels()
-    for ch in channels.get_by_app(app.id):
-        le.remove_channel(app.id, ch.id)
-        channels.delete(ch.id)
-    le.remove_channel(app.id)
-    for ak in storage.get_meta_data_access_keys().get_by_app_id(app.id):
-        storage.get_meta_data_access_keys().delete(ak.key)
-    storage.get_meta_data_apps().delete(app.id)
+    delete_app_cascade(app)
     print(f"App {app.name!r} deleted.")
     return 0
 
 
 def cmd_app_data_delete(args: argparse.Namespace) -> int:
+    from predictionio_tpu.tools.app_ops import delete_app_data
+
     app = _require_app(args.name)
     if not args.force:
         confirm = input(f"Delete event data of app {app.name!r}? (YES to confirm): ")
         if confirm != "YES":
             print("Aborted.")
             return 1
-    le = storage.get_l_events()
-    channels = storage.get_meta_data_channels()
-    if args.channel:
-        match = [c for c in channels.get_by_app(app.id) if c.name == args.channel]
-        if not match:
-            print(f"Error: channel {args.channel!r} does not exist.")
-            return 1
-        le.remove_channel(app.id, match[0].id)
-        le.init_channel(app.id, match[0].id)
-    else:
-        le.remove_channel(app.id)
-        le.init_channel(app.id)
-        if args.all:
-            for ch in channels.get_by_app(app.id):
-                le.remove_channel(app.id, ch.id)
-                le.init_channel(app.id, ch.id)
+    try:
+        delete_app_data(app, channel_name=args.channel, all_channels=args.all)
+    except LookupError as exc:
+        print(f"Error: {exc}.")
+        return 1
     print("Event data deleted.")
     return 0
 

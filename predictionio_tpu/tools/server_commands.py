@@ -12,9 +12,42 @@ def register(sub: argparse._SubParsersAction) -> None:
     es.add_argument("--stats", action="store_true", help="enable /stats.json")
     es.set_defaults(func=cmd_eventserver)
 
+    db = sub.add_parser("dashboard", help="start the evaluation dashboard")
+    db.add_argument("--ip", default="0.0.0.0")
+    db.add_argument("--port", type=int, default=9000)
+    db.set_defaults(func=cmd_dashboard)
+
+    admin = sub.add_parser("adminserver", help="start the admin REST server")
+    admin.add_argument("--ip", default="0.0.0.0")
+    admin.add_argument("--port", type=int, default=7071)
+    admin.set_defaults(func=cmd_adminserver)
+
+    shell = sub.add_parser("shell", help="interactive console with the runtime preloaded")
+    shell.set_defaults(func=cmd_shell)
+
 
 def cmd_eventserver(args: argparse.Namespace) -> int:
     from predictionio_tpu.data.api.eventserver import run_event_server
 
     run_event_server(host=args.ip, port=args.port, stats=args.stats)
     return 0
+
+
+def cmd_dashboard(args: argparse.Namespace) -> int:
+    from predictionio_tpu.tools.dashboard import run_dashboard
+
+    run_dashboard(host=args.ip, port=args.port)
+    return 0
+
+
+def cmd_adminserver(args: argparse.Namespace) -> int:
+    from predictionio_tpu.tools.adminserver import run_admin_server
+
+    run_admin_server(host=args.ip, port=args.port)
+    return 0
+
+
+def cmd_shell(args: argparse.Namespace) -> int:
+    from predictionio_tpu.tools.shell import run_shell
+
+    return run_shell()

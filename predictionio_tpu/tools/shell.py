@@ -1,0 +1,69 @@
+"""``pio shell``: interactive console with the runtime preloaded.
+
+Parity role of ``bin/pio-shell`` + ``python/pypio`` (SURVEY.md section 2.4
+#33, 2.5 #35): where the reference drops into spark-shell/pyspark with pio
+on the classpath and pypio exposing ``init/find_events/save_model``, this
+opens IPython (or code.interact) with the storage registry, event stores,
+and workflow API bound -- and a ``pypio``-shaped helper object.
+"""
+
+from __future__ import annotations
+
+
+class PypioCompat:
+    """pypio-shaped convenience API (reference: pypio.pypio, v0.13+)."""
+
+    def init(self):
+        from predictionio_tpu.data import storage
+
+        failures = storage.verify_all_data_objects()
+        if failures:
+            raise RuntimeError(
+                "storage verification failed: " + "; ".join(failures)
+            )
+        return self
+
+    def find_events(self, app_name: str):
+        """All events of an app as a pandas DataFrame (DataFrame parity)."""
+        import pandas as pd
+
+        from predictionio_tpu.data.store import PEventStore
+
+        return pd.DataFrame([e.to_json_obj() for e in PEventStore.find(app_name)])
+
+    def save_model(self, model_id: str, blob: bytes):
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import Model
+
+        storage.get_model_data_models().insert(Model(id=model_id, models=blob))
+        return model_id
+
+
+def run_shell() -> int:
+    from predictionio_tpu.data import storage
+    from predictionio_tpu.data.store import LEventStore, PEventStore
+    from predictionio_tpu.workflow.context import RuntimeContext
+    from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+    namespace = {
+        "storage": storage,
+        "LEventStore": LEventStore,
+        "PEventStore": PEventStore,
+        "RuntimeContext": RuntimeContext,
+        "load_engine_variant": load_engine_variant,
+        "pypio": PypioCompat(),
+    }
+    banner = (
+        "predictionio_tpu shell -- preloaded: storage, LEventStore, PEventStore,\n"
+        "RuntimeContext, load_engine_variant, pypio (init/find_events/save_model)"
+    )
+    print(banner)
+    try:
+        from IPython import start_ipython
+
+        start_ipython(argv=["--no-banner"], user_ns=namespace)
+    except ImportError:
+        import code
+
+        code.interact(banner="", local=namespace)
+    return 0

@@ -49,6 +49,9 @@ class RuntimeContext:
         #: engine-instance id of the current run (set by the train workflow;
         #: algorithms key step checkpoints on it)
         self.instance_id = instance_id
+        #: per-stage wall-clock seconds, filled by Engine.train (the
+        #: observability the reference delegated to the Spark UI, SURVEY 5.1)
+        self.timings: dict[str, float] = {}
         self._mesh = None
 
     # -- mesh construction --------------------------------------------------

@@ -76,10 +76,22 @@ def run_train(
     )
     instance_id = instances.insert(instance)
     ctx = RuntimeContext(variant.runtime_conf, instance_id=instance_id)
+    profile_dir = variant.runtime_conf.get("pio.profile")
     try:
-        models = engine.train(
-            ctx, engine_params, skip_sanity_check=workflow_params.skip_sanity_check
-        )
+        if profile_dir:
+            # jax profiler trace (xplane, viewable in tensorboard/xprof) --
+            # the Spark-UI replacement for training observability
+            import jax
+
+            trace_ctx = jax.profiler.trace(str(profile_dir))
+        else:
+            import contextlib
+
+            trace_ctx = contextlib.nullcontext()
+        with trace_ctx:
+            models = engine.train(
+                ctx, engine_params, skip_sanity_check=workflow_params.skip_sanity_check
+            )
         blob = engine.serialize_models(ctx, engine_params, instance_id, models)
         storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
         instance.status = STATUS_COMPLETED

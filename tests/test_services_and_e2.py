@@ -1,0 +1,136 @@
+"""Dashboard + admin server REST tests and e2 helper tests."""
+
+import numpy as np
+import pytest
+import requests
+
+from predictionio_tpu.models.e2 import (
+    MarkovChain,
+    categorical_naive_bayes,
+    cross_validation_folds,
+)
+
+
+class TestAdminServer:
+    def test_app_crud_over_rest(self, storage_env):
+        from predictionio_tpu.tools.adminserver import create_admin_server
+
+        svc = create_admin_server(host="127.0.0.1", port=0).start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            assert requests.get(f"{base}/").json()["status"] == "alive"
+            r = requests.post(f"{base}/cmd/app", json={"name": "A1", "description": "d"})
+            assert r.status_code == 201 and "accessKey" in r.json()
+            assert requests.post(f"{base}/cmd/app", json={"name": "A1"}).status_code == 409
+            assert requests.post(f"{base}/cmd/app", json={}).status_code == 400
+            apps = requests.get(f"{base}/cmd/app").json()
+            assert [a["name"] for a in apps] == ["A1"]
+            show = requests.get(f"{base}/cmd/app/A1").json()
+            assert show["id"] == 1 and show["accessKeys"]
+            assert requests.delete(f"{base}/cmd/app/A1/data").status_code == 200
+            assert requests.delete(f"{base}/cmd/app/A1").status_code == 200
+            assert requests.get(f"{base}/cmd/app/A1").status_code == 404
+        finally:
+            svc.stop()
+
+
+class TestDashboard:
+    def test_lists_and_details(self, storage_env):
+        from predictionio_tpu.data.storage.base import (
+            STATUS_COMPLETED,
+            EvaluationInstance,
+        )
+        from predictionio_tpu.tools.dashboard import create_dashboard
+
+        dao = storage_env.get_meta_data_evaluation_instances()
+        iid = dao.insert(
+            EvaluationInstance(
+                status=STATUS_COMPLETED,
+                evaluation_class="my.Eval",
+                evaluator_results="score 0.9",
+                evaluator_results_html="<pre>score 0.9</pre>",
+                evaluator_results_json='{"bestScore": 0.9}',
+                end_time=__import__("datetime").datetime.now(
+                    __import__("datetime").timezone.utc
+                ),
+            )
+        )
+        svc = create_dashboard(host="127.0.0.1", port=0).start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            index = requests.get(f"{base}/")
+            assert "my.Eval" in index.text and "text/html" in index.headers["Content-Type"]
+            detail = requests.get(f"{base}/evaluation_instances/{iid}")
+            assert "score 0.9" in detail.text
+            as_json = requests.get(f"{base}/evaluation_instances/{iid}.json").json()
+            assert as_json["resultsJson"] == '{"bestScore": 0.9}'
+            assert requests.get(f"{base}/evaluation_instances/zzz").status_code == 404
+            listing = requests.get(f"{base}/evaluation_instances.json").json()
+            assert listing[0]["id"] == iid
+            assert requests.get(f"{base}/engine_instances").status_code == 200
+        finally:
+            svc.stop()
+
+
+class TestE2:
+    def test_categorical_naive_bayes(self):
+        records = [{"color": "red", "size": "big"}, {"color": "red", "size": "small"},
+                   {"color": "blue", "size": "big"}, {"color": "blue", "size": "small"}] * 5
+        labels = ["hot", "hot", "cold", "cold"] * 5
+        model = categorical_naive_bayes(records, labels)
+        assert model.predict({"color": "red", "size": "big"}) == "hot"
+        assert model.predict({"color": "blue"}) == "cold"
+        assert model.log_score({"color": "red"}, "hot") > model.log_score(
+            {"color": "red"}, "cold"
+        )
+
+    def test_markov_chain(self):
+        seqs = [["a", "b", "c", "a", "b", "c"], ["a", "b", "a", "b"]] * 3
+        mc = MarkovChain.fit(seqs)
+        assert mc.most_likely_next("a") == "b"
+        dist = mc.next_distribution("a")
+        assert dist["b"] > 0.9
+        assert mc.sequence_log_prob(["a", "b"]) > mc.sequence_log_prob(["a", "c"])
+        with pytest.raises(ValueError):
+            MarkovChain.fit([])
+
+    def test_cross_validation_folds(self):
+        folds = list(cross_validation_folds(10, 3, seed=1))
+        assert len(folds) == 3
+        all_test = np.concatenate([t for _, t in folds])
+        assert sorted(all_test.tolist()) == list(range(10))
+        for train, test in folds:
+            assert set(train) & set(test) == set()
+            assert len(train) + len(test) == 10
+
+
+class TestStageTimings:
+    def test_train_records_timings(self, storage_env, tmp_path):
+        import json as _json
+
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.workflow.context import RuntimeContext
+        from fake_engine import engine_factory
+        from predictionio_tpu.controller.engine import EngineParams
+
+        app_id = storage_env.get_meta_data_apps().insert(App(name="RateApp"))
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        le.insert(
+            Event(event="rate", entity_type="user", entity_id="u",
+                  target_entity_type="item", target_entity_id="i",
+                  properties=DataMap({"rating": 3.0})),
+            app_id=app_id,
+        )
+        ctx = RuntimeContext()
+        engine = engine_factory()
+        engine.train(
+            ctx,
+            EngineParams.from_json_obj(
+                {"datasource": {"params": {"appName": "RateApp"}},
+                 "algorithms": [{"name": "mean", "params": {}}]}
+            ),
+        )
+        assert {"read", "prepare", "train[mean]"} <= set(ctx.timings)
+        assert all(v >= 0 for v in ctx.timings.values())
