@@ -180,6 +180,12 @@ def train_ncf(
                     "epoch": epoch,
                 },
             )
+    if step == 0 and start_epoch < config.epochs:
+        raise ValueError(
+            f"no training steps ran: {n} example(s) cannot fill even one "
+            f"batch across the {n_devices}-way data axis -- use fewer "
+            "devices or more data"
+        )
     return jax.device_get(params), losses
 
 

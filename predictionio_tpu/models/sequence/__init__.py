@@ -1,0 +1,25 @@
+"""Sequential-recommendation template (SASRec-style, ring-attention sp)."""
+
+from predictionio_tpu.models.sequence.engine import (
+    SASRecAlgorithm,
+    SequenceDataSource,
+    SequencePreparator,
+    engine_factory,
+)
+from predictionio_tpu.models.sequence.model import (
+    SASRec,
+    SASRecConfig,
+    score_next_items,
+    train_sasrec,
+)
+
+__all__ = [
+    "SASRec",
+    "SASRecConfig",
+    "SASRecAlgorithm",
+    "SequenceDataSource",
+    "SequencePreparator",
+    "engine_factory",
+    "score_next_items",
+    "train_sasrec",
+]

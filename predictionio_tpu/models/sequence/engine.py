@@ -1,0 +1,236 @@
+"""DASE components of the sequential-recommendation template.
+
+Per-user event histories -> next-item prediction. Query contracts:
+``{"user": "u1", "num": 4}`` (recommend from the user's stored history) and
+``{"items": ["i3", "i9"], "num": 4}`` (session-based: recommend from an
+explicit prefix). Response: ``{"itemScores": [{"item", "score"}, ...]}``.
+
+The reference has no sequence model (nothing in MLlib's template zoo is
+sequential beyond MarkovChain in ``e2``); this family is the long-context
+path of the rebuild (SURVEY.md section 5.7): histories can exceed one chip
+via the ``seq`` mesh axis + ring attention.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    EvalInfo,
+    FirstServing,
+    Preparator,
+    TPUAlgorithm,
+)
+from predictionio_tpu.controller.base import SanityCheck
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.sequence.model import (
+    SASRecConfig,
+    score_next_items,
+    train_sasrec,
+)
+
+
+@dataclass
+class SequencesData(SanityCheck):
+    """Per-user time-ordered item-index sequences + vocabularies.
+
+    Item indices are 0-based here; the model shifts by +1 (0 = padding).
+    """
+
+    sequences: list[np.ndarray]
+    user_ids: list[str]
+    item_ids: list[str]
+
+    def sanity_check(self) -> None:
+        if not self.sequences:
+            raise ValueError("no event sequences found -- check appName/eventNames")
+
+    @property
+    def num_items(self) -> int:
+        return len(self.item_ids)
+
+
+class SequenceDataSource(DataSource):
+    """Groups item-interaction events per user, ordered by event time.
+
+    Params: ``appName`` (required), ``eventNames`` (default
+    ``["view", "buy", "rate"]``), ``minSeqLen`` (drop shorter histories,
+    default 2), ``evalFolds``/``evalK`` for read_eval.
+    """
+
+    def _read(self) -> SequencesData:
+        ds = PEventStore.dataset(
+            self.params.appName,
+            event_names=self.params.get_or("eventNames", ["view", "buy", "rate"]),
+            target_entity_type="item",
+        )
+        valid = ds.target_entity_ids >= 0
+        users = ds.entity_ids[valid]
+        items = ds.target_entity_ids[valid]
+        times = ds.event_times[valid]
+        min_len = self.params.get_or("minSeqLen", 2)
+        by_user: dict[int, list[tuple[float, int]]] = {}
+        for u, i, t in zip(users, items, times):
+            by_user.setdefault(int(u), []).append((float(t), int(i)))
+        sequences, seq_user_ids = [], []
+        for u in sorted(by_user):
+            hist = [i for _, i in sorted(by_user[u], key=lambda p: p[0])]
+            if len(hist) >= min_len:
+                sequences.append(np.asarray(hist, np.int64))
+                seq_user_ids.append(ds.entity_id_vocab[u])
+        return SequencesData(
+            sequences=sequences,
+            user_ids=seq_user_ids,
+            item_ids=ds.target_entity_id_vocab,
+        )
+
+    def read_training(self, ctx) -> SequencesData:
+        return self._read()
+
+    def read_eval(self, ctx):
+        """Leave-one-out per fold: hold out each user's last item as the
+        actual, query on the preceding history (the SASRec protocol)."""
+        data = self._read()
+        folds = self.params.get_or("evalFolds", 1)
+        eval_k = self.params.get_or("evalK", 10)
+        out = []
+        for f in range(folds):
+            train_seqs, pairs, users = [], [], []
+            for uid, seq in zip(data.user_ids, data.sequences):
+                if len(seq) < 3:
+                    train_seqs.append(seq)
+                    users.append(uid)
+                    continue
+                cut = len(seq) - 1 - (f % max(len(seq) - 2, 1))
+                train_seqs.append(seq[:cut])
+                users.append(uid)
+                pairs.append(
+                    (
+                        {"items": [data.item_ids[i] for i in seq[:cut]],
+                         "num": eval_k},
+                        [data.item_ids[seq[cut]]],
+                    )
+                )
+            out.append(
+                (
+                    SequencesData(train_seqs, users, data.item_ids),
+                    EvalInfo(fold=f),
+                    pairs,
+                )
+            )
+        return out
+
+
+@dataclass
+class PackedSequences(SanityCheck):
+    matrix: np.ndarray            # [N, max_len] int32, ids shifted +1, 0 = pad
+    data: SequencesData
+
+    def sanity_check(self) -> None:
+        self.data.sanity_check()
+
+
+class SequencePreparator(Preparator):
+    """Pad/left-truncate histories to maxLen and shift ids (+1, 0 = pad).
+
+    Params: ``maxLen`` (default 64; must be divisible by the mesh seq-axis
+    size when sequence parallelism is on).
+    """
+
+    def prepare(self, ctx, data: SequencesData) -> PackedSequences:
+        max_len = self.params.get_or("maxLen", 64)
+        matrix = np.zeros((len(data.sequences), max_len), np.int32)
+        for row, seq in enumerate(data.sequences):
+            tail = seq[-max_len:] + 1
+            matrix[row, : len(tail)] = tail
+        return PackedSequences(matrix=matrix, data=data)
+
+
+@dataclass
+class SASRecModel:
+    params: dict
+    config: SASRecConfig
+    item_ids: list[str]
+    item_index: dict[str, int]
+    histories: dict[str, np.ndarray]   # user id -> shifted (+1) id sequence
+
+
+class SASRecAlgorithm(TPUAlgorithm):
+    """Params: embedDim, numHeads, numBlocks, ffnDim, dropout, learningRate,
+    batchSize, epochs, seed, maxLen (must match the preparator's)."""
+
+    def train(self, ctx, prepared: PackedSequences) -> SASRecModel:
+        p = self.params
+        data = prepared.data
+        config = SASRecConfig(
+            num_items=data.num_items,
+            max_len=prepared.matrix.shape[1],
+            embed_dim=p.get_or("embedDim", 32),
+            num_heads=p.get_or("numHeads", 2),
+            num_blocks=p.get_or("numBlocks", 2),
+            ffn_dim=p.get_or("ffnDim", 64),
+            dropout=p.get_or("dropout", 0.0),
+            learning_rate=p.get_or("learningRate", 1e-3),
+            batch_size=p.get_or("batchSize", 256),
+            epochs=p.get_or("epochs", 10),
+            seed=p.get_or("seed", 0),
+        )
+        params, _ = train_sasrec(config, prepared.matrix, ctx.mesh)
+        histories = {
+            uid: seq + 1 for uid, seq in zip(data.user_ids, data.sequences)
+        }
+        return SASRecModel(
+            params=params,
+            config=config,
+            item_ids=data.item_ids,
+            item_index={iid: j for j, iid in enumerate(data.item_ids)},
+            histories=histories,
+        )
+
+    def predict(self, model: SASRecModel, query) -> dict:
+        num = int(query.get("num", 10))
+        if query.get("items"):
+            prefix = np.asarray(
+                [
+                    model.item_index[str(i)] + 1
+                    for i in query["items"]
+                    if str(i) in model.item_index
+                ],
+                np.int32,
+            )
+        else:
+            prefix = model.histories.get(str(query.get("user")))
+        if prefix is None or len(prefix) == 0:
+            return {"itemScores": []}
+        scores = score_next_items(model.params, model.config, prefix).astype(
+            np.float64
+        )
+        exclude = {int(i) - 1 for i in prefix} if query.get("unseenOnly", True) else set()
+        exclude |= {
+            model.item_index[str(b)]
+            for b in (query.get("blackList") or [])
+            if str(b) in model.item_index
+        }
+        for j in exclude:
+            scores[j] = -np.inf
+        order = np.argsort(-scores)[:num]
+        return {
+            "itemScores": [
+                {"item": model.item_ids[j], "score": float(scores[j])}
+                for j in order
+                if np.isfinite(scores[j])
+            ]
+        }
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=SequenceDataSource,
+        preparator_class=SequencePreparator,
+        algorithm_class_map={"sasrec": SASRecAlgorithm},
+        serving_class=FirstServing,
+    )

@@ -1,0 +1,239 @@
+"""Self-attentive sequential recommender (SASRec-style) on the device mesh.
+
+The long-context model family: per-user event histories (the reference
+streams these unboundedly through ``PEvents``; SURVEY.md section 5.7) become
+item sequences, and a causal transformer predicts the next item. TPU-first
+design:
+
+- batch shards over the mesh ``data`` axis (dp); the SEQUENCE dim shards
+  over the ``seq`` axis (sp) -- attention across shards runs as ring
+  attention (``parallel.ring_attention``), K/V blocks hopping the ICI ring,
+  so histories longer than one chip's memory train without replication;
+- everything position-local (embedding lookup, LayerNorm, the pointwise
+  FFN) needs no communication under sp: XLA keeps it shard-local;
+- next-item loss is full-softmax cross-entropy against the tied item
+  embedding matrix -- one [B*T, D] x [D, V] matmul on the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.ring_attention import plain_attention, ring_attention
+
+
+@dataclass(frozen=True)
+class SASRecConfig:
+    num_items: int              # real item vocab; id 0 is reserved for padding
+    max_len: int = 64
+    embed_dim: int = 32
+    num_heads: int = 2
+    num_blocks: int = 2
+    ffn_dim: int = 64
+    dropout: float = 0.0
+    learning_rate: float = 1e-3
+    batch_size: int = 256
+    epochs: int = 10
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.embed_dim % self.num_heads:
+            raise ValueError(
+                f"embed_dim={self.embed_dim} must be divisible by "
+                f"num_heads={self.num_heads}"
+            )
+
+    @property
+    def vocab(self) -> int:
+        return self.num_items + 1  # +1 for the padding id 0
+
+
+class _MultiHeadSelfAttention(nn.Module):
+    """Causal MHA whose score computation is mesh-aware: ring attention when
+    the mesh has a >1 ``seq`` axis, plain attention otherwise."""
+
+    config: SASRecConfig
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        c = self.config
+        b, t, d = x.shape
+        h = c.num_heads
+        head_dim = d // h
+        qkv = nn.Dense(3 * d, use_bias=False, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        reshape = lambda a: a.reshape(b, t, h, head_dim)
+        q, k, v = reshape(q), reshape(k), reshape(v)
+        mesh = self.mesh
+        if mesh is not None and mesh.shape.get("seq", 1) > 1:
+            out = ring_attention(q, k, v, mesh, axis_name="seq", causal=True,
+                                 mask=pad_mask)
+        else:
+            out = plain_attention(q, k, v, causal=True, mask=pad_mask)
+        return nn.Dense(d, use_bias=False, name="proj")(out.reshape(b, t, d))
+
+
+class SASRec(nn.Module):
+    config: SASRecConfig
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, seq, deterministic: bool = True):
+        """seq: [B, T] int32, 0 = padding. Returns hidden states [B, T, D]."""
+        c = self.config
+        pad_mask = seq > 0
+        x = nn.Embed(c.vocab, c.embed_dim, name="item_embed")(seq)
+        x = x * (c.embed_dim**0.5)
+        pos = jnp.arange(seq.shape[1])[None, :]
+        x = x + nn.Embed(c.max_len, c.embed_dim, name="pos_embed")(pos)
+        x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
+        for i in range(c.num_blocks):
+            a = nn.LayerNorm(name=f"ln_att_{i}")(x)
+            a = _MultiHeadSelfAttention(c, self.mesh, name=f"att_{i}")(a, pad_mask)
+            x = x + nn.Dropout(c.dropout, deterministic=deterministic)(a)
+            f = nn.LayerNorm(name=f"ln_ffn_{i}")(x)
+            f = nn.Dense(c.ffn_dim, name=f"ffn_in_{i}")(f)
+            f = nn.Dense(c.embed_dim, name=f"ffn_out_{i}")(nn.relu(f))
+            x = x + nn.Dropout(c.dropout, deterministic=deterministic)(f)
+        x = nn.LayerNorm(name="ln_out")(x)
+        return x * pad_mask[..., None]
+
+
+def _logits(params, hidden):
+    """Tied-embedding output head: [B,T,D] x [V,D]^T -> [B,T,V]."""
+    table = params["item_embed"]["embedding"]
+    return jnp.einsum("btd,vd->btv", hidden, table)
+
+
+def make_train_step(model: SASRec, optimizer):
+    def loss_fn(params, batch, rng):
+        hidden = model.apply(
+            {"params": params}, batch["seq"], deterministic=False,
+            rngs={"dropout": rng},
+        )
+        logits = _logits(params, hidden)
+        targets = batch["target"]                     # [B, T], 0 = no target
+        mask = (targets > 0).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        return (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    def train_step(params, opt_state, batch, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return train_step
+
+
+def train_sasrec(
+    config: SASRecConfig,
+    sequences: np.ndarray,   # [N, T] int32 padded item ids (0 = pad)
+    mesh,
+    log_every: int = 0,
+):
+    """Train on next-item prediction; returns (params pytree on host, losses).
+
+    Inputs/targets are the sequence and its left-shift: position t predicts
+    the item at t+1. The [N, T] matrix shards over (data, seq).
+    """
+    t = sequences.shape[1]
+    if t != config.max_len:
+        raise ValueError(f"sequences padded to {t}, config.max_len={config.max_len}")
+    sp = mesh.shape.get("seq", 1)
+    if t % sp:
+        raise ValueError(f"max_len={t} must divide over seq axis size {sp}")
+
+    model = SASRec(config, mesh)
+    rng = jax.random.PRNGKey(config.seed)
+    # dummy batch = one row per data-shard: shard_map needs divisibility
+    dp0 = max(mesh.shape.get("data", 1), 1)
+    params = model.init(rng, jnp.zeros((dp0, t), jnp.int32))["params"]
+    rep = NamedSharding(mesh, P())
+    dp_axis = "data" if "data" in mesh.axis_names else None
+    sp_axis = "seq" if "seq" in mesh.axis_names else None
+    seq_shard = NamedSharding(mesh, P(dp_axis, sp_axis))
+    params = jax.device_put(params, rep)
+    optimizer = optax.adam(config.learning_rate)
+    opt_state = optimizer.init(params)
+
+    step_fn = jax.jit(
+        make_train_step(model, optimizer),
+        in_shardings=(rep, None, {"seq": seq_shard, "target": seq_shard}, None),
+        out_shardings=(rep, None, rep),
+        donate_argnums=(0, 1),
+    )
+
+    inputs = sequences.astype(np.int32)
+    targets = np.zeros_like(inputs)
+    targets[:, :-1] = inputs[:, 1:]
+
+    np_rng = np.random.default_rng(config.seed)
+    n = inputs.shape[0]
+    dp = mesh.shape.get("data", 1)
+    losses = []
+    step = 0
+    for _ in range(config.epochs):
+        order = np_rng.permutation(n)
+        for start in range(0, n, config.batch_size):
+            take = order[start : start + config.batch_size]
+            usable = (take.size // dp) * dp
+            if not usable:
+                continue
+            take = take[:usable]
+            batch = {
+                "seq": jnp.asarray(inputs[take]),
+                "target": jnp.asarray(targets[take]),
+            }
+            params, opt_state, loss = step_fn(
+                params, opt_state, batch, jax.random.fold_in(rng, step)
+            )
+            step += 1
+            if log_every and step % log_every == 0:
+                losses.append(float(loss))
+    if step == 0:
+        raise ValueError(
+            f"no training steps ran: {n} sequence(s) cannot fill even one "
+            f"batch across the {dp}-way data axis -- use fewer devices or "
+            "more data"
+        )
+    return jax.device_get(params), losses
+
+
+_APPLY_CACHE: dict[SASRecConfig, object] = {}
+
+
+def _apply_fn(config: SASRecConfig):
+    """Jitted single-chip forward, cached per config (serving hot path)."""
+    if config not in _APPLY_CACHE:
+        model = SASRec(config, None)
+        _APPLY_CACHE[config] = jax.jit(
+            lambda params, seq: model.apply({"params": params}, seq)
+        )
+    return _APPLY_CACHE[config]
+
+
+def score_next_items(params, config: SASRecConfig, prefix: np.ndarray) -> np.ndarray:
+    """Scores over the item vocab for the next item after ``prefix``.
+
+    ``prefix``: 1-D array of item ids (no padding); uses the last max_len.
+    Returns [num_items] scores (score[i] is for item id i+1 -- id 0 is the
+    padding token and is dropped).
+    """
+    t = config.max_len
+    seq = np.zeros((1, t), np.int32)
+    tail = np.asarray(prefix, np.int32)[-t:]
+    seq[0, : len(tail)] = tail
+    last = max(len(tail) - 1, 0)
+    hidden = _apply_fn(config)(params, jnp.asarray(seq))
+    scores = np.asarray(
+        jnp.einsum("d,vd->v", hidden[0, last], params["item_embed"]["embedding"])
+    )
+    return scores[1:]

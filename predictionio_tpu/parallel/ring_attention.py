@@ -1,0 +1,149 @@
+"""Ring attention: sequence-parallel attention over the device mesh.
+
+Long-context support the reference never had (SURVEY.md section 5.7: the
+nearest analogue is ``PEvents`` streaming arbitrarily long per-entity event
+histories). Sequence models over those histories (the ``models/sequence``
+template) need attention over sequences longer than one chip's memory, so
+the sequence dimension shards over a mesh axis and key/value blocks rotate
+around the ring via ``jax.lax.ppermute`` -- one hop per step, riding ICI,
+never materializing the full [T, T] score matrix on any chip.
+
+Numerics are the flash-attention online softmax, carried ACROSS ring steps:
+each rank keeps running (max, sum, out) statistics for its local queries and
+folds in one remote K/V block per step. ``lax.scan`` keeps the loop static
+for XLA and reverse-mode differentiable (training path).
+
+``plain_attention`` is the single-device reference implementation; the test
+suite checks ring == plain on a virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+_NEG = -1e30  # finite "masked" score: keeps exp() NaN-free on all-masked rows
+
+
+def plain_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    mask: jnp.ndarray | None = None,
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Reference attention. Shapes: q,k,v [B, T, H, D] -> [B, T, H, D].
+
+    ``mask``: optional [B, Tk] key validity (padding) mask.
+    """
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        cm = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(cm[None, None], s, _NEG)
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_attention_local(
+    q, k, v, kv_mask, *, axis_name: str, axis_size: int, causal: bool, sm_scale,
+    mesh_axes: tuple[str, ...] = (),
+):
+    """Per-shard body: local queries stay put, K/V blocks rotate the ring.
+
+    Shapes (per shard): q,k,v [B, Tl, H, D]; kv_mask [B, Tl].
+    """
+    b, t_local, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else d**-0.5
+    my_rank = jax.lax.axis_index(axis_name)
+    q_pos = my_rank * t_local + jnp.arange(t_local)  # global query positions
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def accumulate(acc, blocks, i):
+        """Fold one K/V block (originally from rank ``my_rank - i``) into the
+        running flash-attention statistics."""
+        o, m, l = acc
+        k_blk, v_blk, msk_blk = blocks
+        src = (my_rank - i) % axis_size
+        k_pos = src * t_local + jnp.arange(t_local)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        valid = msk_blk[:, None, None, :]  # [B,1,1,Tk]
+        if causal:
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])[None, None]
+        s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None]) * valid  # zero fully-masked entries
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        return o, m_new, l
+
+    # fresh constants are "unvarying" under shard_map's vma tracking; the
+    # scan carry must match the varying outputs, so cast them explicitly
+    pvary = lambda x: jax.lax.pcast(x, mesh_axes, to="varying") if mesh_axes else x
+    o0 = pvary(jnp.zeros((b, h, t_local, d), q.dtype))
+    m0 = pvary(jnp.full((b, h, t_local), _NEG, q.dtype))
+    l0 = pvary(jnp.zeros((b, h, t_local), q.dtype))
+
+    # step 0 folds the resident block; steps 1..S-1 rotate FIRST, then fold --
+    # no ring hop is spent producing a block nobody reads
+    acc = accumulate((o0, m0, l0), (k, v, kv_mask), 0)
+
+    def step(carry, i):
+        acc, blocks = carry
+        blocks = tuple(jax.lax.ppermute(x, axis_name, perm) for x in blocks)
+        return (accumulate(acc, blocks, i), blocks), None
+
+    if axis_size > 1:
+        (acc, _), _ = jax.lax.scan(
+            step, (acc, (k, v, kv_mask)), jnp.arange(1, axis_size)
+        )
+    o, _, l = acc
+    o = o / jnp.maximum(l, 1e-20)[..., None]
+    return o.transpose(0, 2, 1, 3)  # [B, Tl, H, D]
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh,
+    axis_name: str = "seq",
+    causal: bool = True,
+    mask: jnp.ndarray | None = None,
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Attention with the sequence dim sharded over ``mesh[axis_name]``.
+
+    Global shapes: q,k,v [B, T, H, D] with T divisible by the axis size;
+    ``mask`` [B, T] marks valid (non-padding) key positions. Batch shards
+    over the mesh's ``data`` axis when present (dp x sp composes).
+    """
+    if mask is None:
+        mask = jnp.ones(q.shape[:2], bool)
+    axis_size = mesh.shape[axis_name]
+    batch_axis = "data" if "data" in mesh.axis_names else None
+    spec = P(batch_axis, axis_name, None, None)
+    mspec = P(batch_axis, axis_name)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local,
+            axis_name=axis_name,
+            axis_size=axis_size,
+            causal=causal,
+            sm_scale=sm_scale,
+            mesh_axes=tuple(mesh.axis_names),
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, mspec),
+        out_specs=spec,
+    )
+    return fn(q, k, v, mask)

@@ -1,0 +1,32 @@
+"""JAX backend resolution shared by the train and serve entry points.
+
+Accelerator plugins (e.g. a tunneled TPU) can be registered but broken; a
+server or CLI must degrade to the host backend instead of dying. Honors
+``PIO_PLATFORM`` (env) as an explicit override.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger("pio.platform")
+
+
+def ensure_backend(platform: str | None = None) -> str:
+    """Make sure SOME JAX backend initializes; returns its platform name.
+
+    Resolution order: explicit ``platform`` arg > ``PIO_PLATFORM`` env >
+    JAX default, falling back to CPU when the preferred backend fails.
+    """
+    import jax
+
+    want = platform or os.environ.get("PIO_PLATFORM")
+    if want:
+        jax.config.update("jax_platforms", want)
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError as exc:
+        logger.warning("accelerator backend unavailable (%s); using CPU", exc)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform

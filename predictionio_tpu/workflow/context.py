@@ -73,6 +73,11 @@ class RuntimeContext:
                 num_processes=int(os.environ.get("PIO_NUM_PROCESSES", "1")),
                 process_id=int(os.environ.get("PIO_PROCESS_ID", "0")),
             )
+        from predictionio_tpu.utils.platform import ensure_backend
+
+        # a wedged or unregistered accelerator plugin must not take the
+        # whole training CLI down -- ensure_backend falls back to CPU
+        ensure_backend(self.runtime_conf.get("pio.platform"))
         devices = jax.devices()
         shape = self.runtime_conf.get("pio.mesh_shape", [-1, 1])
         axes = tuple(self.runtime_conf.get("pio.mesh_axes", ("data", "model")))

@@ -104,9 +104,14 @@ class QueryService:
     # -- model lifecycle ----------------------------------------------------
     def _load_models(self) -> None:
         from predictionio_tpu.data import storage
+        from predictionio_tpu.utils.platform import ensure_backend
 
         instance = resolve_engine_instance(self.variant, self.requested_instance_id)
         engine_params = engine_params_from_instance(instance)
+        # resolve the instance FIRST so an explicit pio.platform in its
+        # runtime conf wins; serving must come up even with a wedged
+        # accelerator plugin (ensure_backend falls back to CPU)
+        ensure_backend((instance.runtime_conf or {}).get("pio.platform"))
         blob_record = storage.get_model_data_models().get(instance.id)
         ctx = RuntimeContext(instance.runtime_conf)
         models = self.engine.prepare_deploy(
