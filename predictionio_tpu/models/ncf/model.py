@@ -22,6 +22,8 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.parallel.mesh import check_steps_ran
+
 
 @dataclass
 class NCFConfig:
@@ -180,12 +182,8 @@ def train_ncf(
                     "epoch": epoch,
                 },
             )
-    if step == 0 and start_epoch < config.epochs:
-        raise ValueError(
-            f"no training steps ran: {n} example(s) cannot fill even one "
-            f"batch across the {n_devices}-way data axis -- use fewer "
-            "devices or more data"
-        )
+    if start_epoch < config.epochs:
+        check_steps_ran(step, n, n_devices, "example")
     return jax.device_get(params), losses
 
 

@@ -73,15 +73,19 @@ class SequenceDataSource(DataSource):
         items = ds.target_entity_ids[valid]
         times = ds.event_times[valid]
         min_len = self.params.get_or("minSeqLen", 2)
-        by_user: dict[int, list[tuple[float, int]]] = {}
-        for u, i, t in zip(users, items, times):
-            by_user.setdefault(int(u), []).append((float(t), int(i)))
+        # one vectorized (user, time) sort, then a grouped scan -- the same
+        # grouping idiom as the similar-product / UR templates
         sequences, seq_user_ids = [], []
-        for u in sorted(by_user):
-            hist = [i for _, i in sorted(by_user[u], key=lambda p: p[0])]
-            if len(hist) >= min_len:
-                sequences.append(np.asarray(hist, np.int64))
-                seq_user_ids.append(ds.entity_id_vocab[u])
+        if users.size:
+            order = np.lexsort((times, users))
+            users, items = users[order], items[order]
+            boundaries = np.flatnonzero(np.diff(users)) + 1
+            for hist, u in zip(
+                np.split(items, boundaries), users[np.r_[0, boundaries]]
+            ):
+                if len(hist) >= min_len:
+                    sequences.append(hist.astype(np.int64))
+                    seq_user_ids.append(ds.entity_id_vocab[int(u)])
         return SequencesData(
             sequences=sequences,
             user_ids=seq_user_ids,
@@ -161,11 +165,20 @@ class SASRecModel:
 
 class SASRecAlgorithm(TPUAlgorithm):
     """Params: embedDim, numHeads, numBlocks, ffnDim, dropout, learningRate,
-    batchSize, epochs, seed, maxLen (must match the preparator's)."""
+    batchSize, epochs, seed, maxLen (must match the preparator's), and
+    seqParallel ("ring" | "ulysses") selecting the sequence-parallel
+    attention strategy when the mesh has a >1 ``seq`` axis."""
 
     def train(self, ctx, prepared: PackedSequences) -> SASRecModel:
         p = self.params
         data = prepared.data
+        max_len = p.get_or("maxLen", None)
+        if max_len is not None and max_len != prepared.matrix.shape[1]:
+            raise ValueError(
+                f"algorithm maxLen={max_len} != preparator maxLen="
+                f"{prepared.matrix.shape[1]}; set both to the same value "
+                "(or drop the algorithm's)"
+            )
         config = SASRecConfig(
             num_items=data.num_items,
             max_len=prepared.matrix.shape[1],
@@ -178,6 +191,7 @@ class SASRecAlgorithm(TPUAlgorithm):
             batch_size=p.get_or("batchSize", 256),
             epochs=p.get_or("epochs", 10),
             seed=p.get_or("seed", 0),
+            seq_parallel=p.get_or("seqParallel", "ring"),
         )
         params, _ = train_sasrec(config, prepared.matrix, ctx.mesh)
         histories = {

@@ -26,7 +26,9 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.parallel.mesh import check_steps_ran
 from predictionio_tpu.parallel.ring_attention import plain_attention, ring_attention
+from predictionio_tpu.parallel.ulysses import ulysses_attention
 
 
 @dataclass(frozen=True)
@@ -42,12 +44,17 @@ class SASRecConfig:
     batch_size: int = 256
     epochs: int = 10
     seed: int = 0
+    seq_parallel: str = "ring"  # "ring" | "ulysses" (all-to-all head scatter)
 
     def __post_init__(self):
         if self.embed_dim % self.num_heads:
             raise ValueError(
                 f"embed_dim={self.embed_dim} must be divisible by "
                 f"num_heads={self.num_heads}"
+            )
+        if self.seq_parallel not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq_parallel={self.seq_parallel!r}: want 'ring' or 'ulysses'"
             )
 
     @property
@@ -74,8 +81,9 @@ class _MultiHeadSelfAttention(nn.Module):
         q, k, v = reshape(q), reshape(k), reshape(v)
         mesh = self.mesh
         if mesh is not None and mesh.shape.get("seq", 1) > 1:
-            out = ring_attention(q, k, v, mesh, axis_name="seq", causal=True,
-                                 mask=pad_mask)
+            sp_attn = ulysses_attention if c.seq_parallel == "ulysses" else ring_attention
+            out = sp_attn(q, k, v, mesh, axis_name="seq", causal=True,
+                          mask=pad_mask)
         else:
             out = plain_attention(q, k, v, causal=True, mask=pad_mask)
         return nn.Dense(d, use_bias=False, name="proj")(out.reshape(b, t, d))
@@ -198,12 +206,7 @@ def train_sasrec(
             step += 1
             if log_every and step % log_every == 0:
                 losses.append(float(loss))
-    if step == 0:
-        raise ValueError(
-            f"no training steps ran: {n} sequence(s) cannot fill even one "
-            f"batch across the {dp}-way data axis -- use fewer devices or "
-            "more data"
-        )
+    check_steps_ran(step, n, dp, "sequence")
     return jax.device_get(params), losses
 
 

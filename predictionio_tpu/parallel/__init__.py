@@ -20,5 +20,15 @@ from predictionio_tpu.parallel.mesh import (
     row_sharded,
     shard_rows,
 )
+from predictionio_tpu.parallel.ring_attention import plain_attention, ring_attention
+from predictionio_tpu.parallel.ulysses import ulysses_attention
 
-__all__ = ["local_mesh", "replicated", "row_sharded", "shard_rows"]
+__all__ = [
+    "local_mesh",
+    "replicated",
+    "row_sharded",
+    "shard_rows",
+    "plain_attention",
+    "ring_attention",
+    "ulysses_attention",
+]

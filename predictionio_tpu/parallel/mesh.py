@@ -29,6 +29,34 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def check_steps_ran(steps: int, n_examples: int, data_axis_size: int, what: str):
+    """Raise when a training loop completed without a single step: the data
+    can't fill even one batch across the data axis (shared guard for the
+    sharded model templates)."""
+    if steps == 0:
+        raise ValueError(
+            f"no training steps ran: {n_examples} {what}(s) cannot fill even "
+            f"one batch across the {data_axis_size}-way data axis -- use "
+            "fewer devices or more data"
+        )
+
+
+def seq_parallel_shard_map(body, mesh: Mesh, axis_name: str):
+    """shard_map wrapper shared by the sequence-parallel attention
+    strategies: q,k,v [B, T, H, D] shard as (data?, axis_name, None, None),
+    the [B, T] key mask as (data?, axis_name). Keeps ring and Ulysses on one
+    contract (mask defaulting and batch-axis resolution live in the callers'
+    shared entry, this is the spec plumbing)."""
+    from jax.sharding import PartitionSpec as P
+
+    batch_axis = "data" if "data" in mesh.axis_names else None
+    spec = P(batch_axis, axis_name, None, None)
+    mspec = P(batch_axis, axis_name)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec
+    )
+
+
 def shard_rows(mesh: Mesh, *arrays, axis: str = "data"):
     """Pad rows to the axis size and device_put sharded on the leading dim."""
     n_shards = mesh.shape[axis]

@@ -23,7 +23,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import seq_parallel_shard_map
 
 _NEG = -1e30  # finite "masked" score: keeps exp() NaN-free on all-masked rows
 
@@ -130,10 +131,7 @@ def ring_attention(
     if mask is None:
         mask = jnp.ones(q.shape[:2], bool)
     axis_size = mesh.shape[axis_name]
-    batch_axis = "data" if "data" in mesh.axis_names else None
-    spec = P(batch_axis, axis_name, None, None)
-    mspec = P(batch_axis, axis_name)
-    fn = jax.shard_map(
+    fn = seq_parallel_shard_map(
         functools.partial(
             _ring_attention_local,
             axis_name=axis_name,
@@ -142,8 +140,7 @@ def ring_attention(
             sm_scale=sm_scale,
             mesh_axes=tuple(mesh.axis_names),
         ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec, mspec),
-        out_specs=spec,
+        mesh,
+        axis_name,
     )
     return fn(q, k, v, mask)
