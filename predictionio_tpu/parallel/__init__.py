@@ -14,6 +14,11 @@ MLlib ALS's internal block model-parallelism. Here:
   ``jax.distributed`` (``workflow.context`` initializes multi-host)
 """
 
+from predictionio_tpu.parallel.distributed import (
+    build_mesh,
+    host_local_batch,
+    init_distributed,
+)
 from predictionio_tpu.parallel.mesh import (
     local_mesh,
     replicated,
@@ -24,6 +29,9 @@ from predictionio_tpu.parallel.ring_attention import plain_attention, ring_atten
 from predictionio_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
+    "build_mesh",
+    "host_local_batch",
+    "init_distributed",
     "local_mesh",
     "replicated",
     "row_sharded",

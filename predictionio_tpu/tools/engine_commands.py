@@ -197,8 +197,10 @@ def _parse_passthrough(tokens: list[str]) -> dict:
                 i += 1
             else:
                 value = "true"
-            if key == "mesh_shape":
-                conf["pio.mesh_shape"] = [int(x) for x in value.split(",")]
+            if key in ("mesh_shape", "dcn_mesh_shape"):
+                conf[f"pio.{key}"] = [int(x) for x in value.split(",")]
+            elif key == "mesh_axes":
+                conf["pio.mesh_axes"] = value.split(",")
             else:
                 conf[f"pio.{key}"] = value
         i += 1

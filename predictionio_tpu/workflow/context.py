@@ -15,11 +15,14 @@ uses ``jax.distributed.initialize`` when ``PIO_COORDINATOR`` is set.
 from __future__ import annotations
 
 import logging
-import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Mapping
 
 logger = logging.getLogger("pio.workflow")
+
+
+def _maybe_int(value) -> int | None:
+    return None if value is None else int(value)
 
 
 @dataclass
@@ -62,48 +65,26 @@ class RuntimeContext:
         return self._mesh
 
     def _build_mesh(self):
-        import jax
-        import numpy as np
-        from jax.sharding import Mesh
+        from predictionio_tpu.parallel.distributed import build_mesh, init_distributed
 
-        if os.environ.get("PIO_COORDINATOR"):
-            # multi-host pod: one process per host, XLA collectives over ICI/DCN
-            jax.distributed.initialize(
-                coordinator_address=os.environ["PIO_COORDINATOR"],
-                num_processes=int(os.environ.get("PIO_NUM_PROCESSES", "1")),
-                process_id=int(os.environ.get("PIO_PROCESS_ID", "0")),
-            )
+        # multi-host pod: one process per host, coordinator from runtime
+        # conf (-- --coordinator host:port) or PIO_COORDINATOR env; XLA
+        # collectives over ICI/DCN (parallel.distributed)
+        init_distributed(
+            coordinator=self.runtime_conf.get("pio.coordinator"),
+            num_processes=_maybe_int(self.runtime_conf.get("pio.num_processes")),
+            process_id=_maybe_int(self.runtime_conf.get("pio.process_id")),
+        )
         from predictionio_tpu.utils.platform import ensure_backend
 
         # a wedged or unregistered accelerator plugin must not take the
         # whole training CLI down -- ensure_backend falls back to CPU
         ensure_backend(self.runtime_conf.get("pio.platform"))
-        devices = jax.devices()
-        shape = self.runtime_conf.get("pio.mesh_shape", [-1, 1])
-        axes = tuple(self.runtime_conf.get("pio.mesh_axes", ("data", "model")))
-        if len(shape) != len(axes):
-            raise ValueError(
-                f"mesh_shape {shape} and mesh_axes {axes} have different ranks"
-            )
-        resolved = list(shape)
-        if -1 in resolved:
-            known = 1
-            for s in resolved:
-                if s != -1:
-                    known *= s
-            resolved[resolved.index(-1)] = len(devices) // known
-        total = 1
-        for s in resolved:
-            total *= s
-        if total > len(devices):
-            raise ValueError(
-                f"mesh shape {resolved} needs {total} devices, have {len(devices)}"
-            )
-        device_grid = np.array(devices[:total]).reshape(resolved)
-        mesh = Mesh(device_grid, axes)
-        logger.info("mesh: %s over %d %s device(s)",
-                    dict(zip(axes, resolved)), total, devices[0].platform)
-        return mesh
+        return build_mesh(
+            self.runtime_conf.get("pio.mesh_shape", [-1, 1]),
+            tuple(self.runtime_conf.get("pio.mesh_axes", ("data", "model"))),
+            dcn_mesh_shape=self.runtime_conf.get("pio.dcn_mesh_shape"),
+        )
 
     @property
     def num_devices(self) -> int:

@@ -33,6 +33,7 @@ from predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     Model,
 )
+from predictionio_tpu.parallel.distributed import strip_launch_conf
 from predictionio_tpu.workflow.context import RuntimeContext, WorkflowParams
 from predictionio_tpu.workflow.json_extractor import EngineVariant, build_engine
 
@@ -66,7 +67,7 @@ def run_train(
         engine_factory=variant.engine_factory,
         batch=workflow_params.batch,
         env={k: v for k, v in os.environ.items() if k.startswith("PIO_")},
-        runtime_conf=variant.runtime_conf,
+        runtime_conf=strip_launch_conf(variant.runtime_conf),
         data_source_params=json.dumps(dict(engine_params.data_source_params)),
         preparator_params=json.dumps(dict(engine_params.preparator_params)),
         algorithms_params=json.dumps(

@@ -1,0 +1,124 @@
+"""Multi-host runtime helpers on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from predictionio_tpu.parallel.distributed import (
+    build_mesh,
+    host_local_batch,
+    init_distributed,
+)
+from predictionio_tpu.workflow.context import RuntimeContext
+
+
+def test_build_mesh_wildcard():
+    mesh = build_mesh([-1, 2], ("data", "model"))
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+
+def test_build_mesh_rank_mismatch():
+    with pytest.raises(ValueError, match="different ranks"):
+        build_mesh([2, 2, 2], ("data", "model"))
+
+
+def test_build_mesh_too_many_devices():
+    with pytest.raises(ValueError, match="needs"):
+        build_mesh([16, 1], ("data", "model"))
+
+
+def test_hybrid_mesh_single_slice():
+    # dcn factors of 1 = one slice; shape must match the plain mesh's
+    mesh = build_mesh([4, 2], ("data", "model"), dcn_mesh_shape=[1, 1])
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    assert mesh.devices.size == 8
+
+
+def test_hybrid_mesh_wildcard_and_rank_check():
+    mesh = build_mesh([-1, 1], ("data", "model"), dcn_mesh_shape=[1, 1])
+    assert dict(mesh.shape) == {"data": 8, "model": 1}
+    with pytest.raises(ValueError, match="different ranks"):
+        build_mesh([4, 2], ("data", "model"), dcn_mesh_shape=[1])
+
+
+def test_init_distributed_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv("PIO_COORDINATOR", raising=False)
+    assert init_distributed() is False
+
+
+def test_host_local_batch_assembles_global_arrays():
+    mesh = build_mesh([8, 1], ("data", "model"))
+    local = {"x": np.arange(16, dtype=np.float32).reshape(16, 1)}
+    out = host_local_batch(mesh, P("data"), local)
+    assert isinstance(out["x"], jax.Array)
+    assert out["x"].shape == (16, 1)
+    np.testing.assert_array_equal(np.asarray(out["x"]), local["x"])
+    # the array really is sharded over data: 8 addressable shards of 2 rows
+    assert len(out["x"].addressable_shards) == 8
+    assert out["x"].addressable_shards[0].data.shape == (2, 1)
+
+
+def test_runtime_context_builds_hybrid_mesh():
+    ctx = RuntimeContext(
+        {
+            "pio.mesh_shape": [2, 4],
+            "pio.mesh_axes": ["data", "seq"],
+            "pio.dcn_mesh_shape": [1, 1],
+        }
+    )
+    assert dict(ctx.mesh.shape) == {"data": 2, "seq": 4}
+
+
+def test_passthrough_parses_distributed_flags():
+    from predictionio_tpu.tools.engine_commands import _parse_passthrough
+
+    conf = _parse_passthrough(
+        [
+            "--mesh-shape", "2,4",
+            "--dcn-mesh-shape", "2,1",
+            "--mesh-axes", "data,seq",
+            "--coordinator", "10.0.0.1:8476",
+            "--num-processes", "2",
+        ]
+    )
+    assert conf["pio.mesh_shape"] == [2, 4]
+    assert conf["pio.dcn_mesh_shape"] == [2, 1]
+    assert conf["pio.mesh_axes"] == ["data", "seq"]
+    assert conf["pio.coordinator"] == "10.0.0.1:8476"
+    assert conf["pio.num_processes"] == "2"
+
+
+def test_hybrid_mesh_oversubscription_is_clear():
+    with pytest.raises(ValueError, match="needs 32 devices, have 8"):
+        build_mesh([4, 2], ("data", "model"), dcn_mesh_shape=[4, 1])
+
+
+def test_launch_conf_not_persisted():
+    """Coordinator/rank flags are launch-scoped: a deploy must never replay
+    the training run's coordinator from the stored EngineInstance."""
+    from predictionio_tpu.parallel.distributed import strip_launch_conf
+
+    conf = {
+        "pio.mesh_shape": [2, 4],
+        "pio.coordinator": "10.0.0.1:8476",
+        "pio.num_processes": "2",
+        "pio.process_id": "1",
+    }
+    assert strip_launch_conf(conf) == {"pio.mesh_shape": [2, 4]}
+    assert strip_launch_conf(None) == {}
+
+
+def test_sharded_compute_on_hybrid_mesh():
+    """A psum over the data axis compiles + runs on the hybrid mesh."""
+    mesh = build_mesh([4, 2], ("data", "model"), dcn_mesh_shape=[1, 1])
+    x = host_local_batch(mesh, P("data"), np.ones((8, 4), np.float32))
+
+    def body(x):
+        return jax.lax.psum(x.sum(), "data")
+
+    out = jax.shard_map(
+        body, mesh=mesh, in_specs=P("data"), out_specs=P()
+    )(x)
+    assert float(np.asarray(out)) == 32.0
