@@ -97,6 +97,68 @@ class ExampleFormConnector(FormConnector):
         }
 
 
+class MailChimpConnector(FormConnector):
+    """MailChimp webhook mapping (MailChimpConnector parity role).
+
+    MailChimp posts form-encoded fields: ``type`` (subscribe / unsubscribe /
+    profile / upemail / cleaned / campaign), ``fired_at``, and bracketed
+    ``data[...]`` fields. Subscriber events map to entityType=user (the
+    subscriber id) targeting the list; campaign events map the campaign
+    targeting the list.
+    """
+
+    _SUBSCRIBER_TYPES = ("subscribe", "unsubscribe", "profile", "upemail", "cleaned")
+
+    def to_event_json(self, form):
+        mc_type = form.get("type")
+        if not mc_type:
+            raise ConnectorError("mailchimp form missing 'type'")
+        data = {
+            k[len("data["):-1]: v
+            for k, v in form.items()
+            if k.startswith("data[") and k.endswith("]") and "][" not in k
+        }
+        properties = dict(data)
+
+        if mc_type in self._SUBSCRIBER_TYPES:
+            # upemail payloads carry new_id/new_email instead of id/email
+            entity_id = (
+                data.get("id")
+                or data.get("new_id")
+                or data.get("email")
+                or data.get("new_email")
+            )
+            if not entity_id:
+                raise ConnectorError(
+                    f"mailchimp {mc_type!r} form missing data[id]/data[email]"
+                )
+            out = {
+                "event": mc_type,
+                "entityType": "user",
+                "entityId": str(entity_id),
+                "properties": properties,
+            }
+        elif mc_type == "campaign":
+            if not data.get("id"):
+                raise ConnectorError("mailchimp campaign form missing data[id]")
+            out = {
+                "event": mc_type,
+                "entityType": "campaign",
+                "entityId": str(data["id"]),
+                "properties": properties,
+            }
+        else:
+            raise ConnectorError(f"mailchimp webhook type {mc_type!r} not supported")
+
+        if data.get("list_id"):
+            out["targetEntityType"] = "list"
+            out["targetEntityId"] = str(data["list_id"])
+        if form.get("fired_at"):
+            # MailChimp timestamps are naive UTC "YYYY-MM-DD HH:MM:SS"
+            out["eventTime"] = form["fired_at"].replace(" ", "T") + "+00:00"
+        return out
+
+
 #: path segment under /webhooks/ -> connector instance
 JSON_CONNECTORS: dict[str, JsonConnector] = {
     "example": ExampleJsonConnector(),
@@ -104,6 +166,7 @@ JSON_CONNECTORS: dict[str, JsonConnector] = {
 }
 FORM_CONNECTORS: dict[str, FormConnector] = {
     "exampleform": ExampleFormConnector(),
+    "mailchimp": MailChimpConnector(),
 }
 
 

@@ -39,6 +39,7 @@ _INITIALIZED = False
 #: not be replayed from a persisted EngineInstance (a serving process would
 #: try to join the long-dead training coordinator as the wrong rank)
 LAUNCH_SCOPED_KEYS = ("pio.coordinator", "pio.num_processes", "pio.process_id")
+LAUNCH_SCOPED_ENV = ("PIO_COORDINATOR", "PIO_NUM_PROCESSES", "PIO_PROCESS_ID")
 
 
 def strip_launch_conf(runtime_conf: dict | None) -> dict:
@@ -133,10 +134,13 @@ def build_mesh(
             )
         resolved = _resolve_wildcard(mesh_shape, len(devices) // dcn_total)
         total = _prod(resolved) * dcn_total
-        if total > len(devices):
+        if total != len(devices):
+            # create_hybrid_device_mesh requires the exact fleet; an under-
+            # subscribed shape would die deep inside jax with no context
             raise ValueError(
-                f"mesh shape {resolved} x dcn {dcn_mesh_shape} needs {total} "
-                f"devices, have {len(devices)}"
+                f"mesh shape {resolved} x dcn {dcn_mesh_shape} covers {total} "
+                f"device(s) but the fleet has {len(devices)}; a hybrid mesh "
+                "must use every device (use -1 wildcards to auto-fill)"
             )
         # TPU slices carry slice_index; CPU/virtual devices don't, so the
         # DCN granule degrades to the process there (the CI/test path)
@@ -182,12 +186,6 @@ def host_local_batch(mesh, spec, local_arrays):
     sharding = NamedSharding(mesh, spec)
     put = lambda x: jax.make_array_from_process_local_data(sharding, x)
     return jax.tree_util.tree_map(put, local_arrays)
-
-
-def process_count() -> int:
-    import jax
-
-    return jax.process_count()
 
 
 def _prod(xs) -> int:

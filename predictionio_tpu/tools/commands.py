@@ -12,12 +12,14 @@ import argparse
 def register(sub: argparse._SubParsersAction) -> None:
     from predictionio_tpu.tools import (
         app_commands,
+        build_commands,
         engine_commands,
         import_export,
         server_commands,
     )
 
     app_commands.register(sub)
+    build_commands.register(sub)
     engine_commands.register(sub)
     import_export.register(sub)
     server_commands.register(sub)

@@ -10,36 +10,8 @@ and workflow API bound -- and a ``pypio``-shaped helper object.
 from __future__ import annotations
 
 
-class PypioCompat:
-    """pypio-shaped convenience API (reference: pypio.pypio, v0.13+)."""
-
-    def init(self):
-        from predictionio_tpu.data import storage
-
-        failures = storage.verify_all_data_objects()
-        if failures:
-            raise RuntimeError(
-                "storage verification failed: " + "; ".join(failures)
-            )
-        return self
-
-    def find_events(self, app_name: str):
-        """All events of an app as a pandas DataFrame (DataFrame parity)."""
-        import pandas as pd
-
-        from predictionio_tpu.data.store import PEventStore
-
-        return pd.DataFrame([e.to_json_obj() for e in PEventStore.find(app_name)])
-
-    def save_model(self, model_id: str, blob: bytes):
-        from predictionio_tpu.data import storage
-        from predictionio_tpu.data.storage.base import Model
-
-        storage.get_model_data_models().insert(Model(id=model_id, models=blob))
-        return model_id
-
-
 def run_shell() -> int:
+    from predictionio_tpu import pypio
     from predictionio_tpu.data import storage
     from predictionio_tpu.data.store import LEventStore, PEventStore
     from predictionio_tpu.workflow.context import RuntimeContext
@@ -51,7 +23,7 @@ def run_shell() -> int:
         "PEventStore": PEventStore,
         "RuntimeContext": RuntimeContext,
         "load_engine_variant": load_engine_variant,
-        "pypio": PypioCompat(),
+        "pypio": pypio,
     }
     banner = (
         "predictionio_tpu shell -- preloaded: storage, LEventStore, PEventStore,\n"

@@ -33,7 +33,10 @@ from predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     Model,
 )
-from predictionio_tpu.parallel.distributed import strip_launch_conf
+from predictionio_tpu.parallel.distributed import (
+    LAUNCH_SCOPED_ENV,
+    strip_launch_conf,
+)
 from predictionio_tpu.workflow.context import RuntimeContext, WorkflowParams
 from predictionio_tpu.workflow.json_extractor import EngineVariant, build_engine
 
@@ -42,6 +45,16 @@ logger = logging.getLogger("pio.workflow")
 
 def _utcnow() -> _dt.datetime:
     return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _pio_env() -> dict[str, str]:
+    """PIO_* env snapshot persisted on instances -- minus launch identity
+    (coordinator/rank vars must not be replayed, distributed.py invariant)."""
+    return {
+        k: v
+        for k, v in os.environ.items()
+        if k.startswith("PIO_") and k not in LAUNCH_SCOPED_ENV
+    }
 
 
 def run_train(
@@ -66,7 +79,7 @@ def run_train(
         engine_variant=variant.path,
         engine_factory=variant.engine_factory,
         batch=workflow_params.batch,
-        env={k: v for k, v in os.environ.items() if k.startswith("PIO_")},
+        env=_pio_env(),
         runtime_conf=strip_launch_conf(variant.runtime_conf),
         data_source_params=json.dumps(dict(engine_params.data_source_params)),
         preparator_params=json.dumps(dict(engine_params.preparator_params)),
@@ -124,7 +137,7 @@ def run_evaluation(
         evaluation_class=evaluation_class,
         engine_params_generator_class=generator_class,
         batch=batch,
-        env={k: v for k, v in os.environ.items() if k.startswith("PIO_")},
+        env=_pio_env(),
     )
     instance_id = instances.insert(instance)
     ctx = RuntimeContext(runtime_conf)

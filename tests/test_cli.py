@@ -55,3 +55,65 @@ class TestAppVerbs:
         assert "ready to go" in out
         code, out = run(capsys, "version")
         assert code == 0
+
+
+class TestBuildVerbs:
+    def test_template_list_and_get(self, storage_env, tmp_path, capsys):
+        code, out = run(capsys, "template", "list")
+        assert code == 0
+        assert "recommendation" in out and "ncf" in out
+
+        dst = tmp_path / "my-engine"
+        code, out = run(
+            capsys, "template", "get", "recommendation", str(dst),
+            "--app-name", "Shop",
+        )
+        assert code == 0
+        assert (dst / "engine.json").exists()
+        import json
+
+        variant = json.loads((dst / "engine.json").read_text())
+        assert variant["datasource"]["params"]["appName"] == "Shop"
+
+        # refuse to clobber a non-empty destination
+        code, out = run(capsys, "template", "get", "recommendation", str(dst))
+        assert code == 1
+
+        code, out = run(capsys, "template", "get", "nope", str(tmp_path / "x"))
+        assert code == 1
+
+    def test_build_validates_engine_dir(self, storage_env, tmp_path, capsys):
+        dst = tmp_path / "engine"
+        run(capsys, "template", "get", "classification", str(dst))
+        code, out = run(capsys, "build", "--engine-dir", str(dst), "--verbose")
+        assert code == 0
+        assert "Build finished" in out
+
+        (dst / "engine.json").write_text('{"engineFactory": "no.such.module"}')
+        code, out = run(capsys, "build", "--engine-dir", str(dst))
+        assert code == 1
+        assert "Error" in out
+
+    def test_build_template_json_version_gate(self, storage_env, tmp_path, capsys):
+        import json
+
+        dst = tmp_path / "engine"
+        run(capsys, "template", "get", "recommendation", str(dst))
+        (dst / "template.json").write_text(
+            json.dumps({"pio": {"version": {"min": "999.0.0"}}})
+        )
+        code, out = run(capsys, "build", "--engine-dir", str(dst))
+        assert code == 0  # warn, do not fail (reference behavior: warning)
+        assert "Warning" in out and "999.0.0" in out
+
+    def test_run_script(self, storage_env, tmp_path, capsys):
+        script = tmp_path / "main.py"
+        script.write_text(
+            "import sys\n"
+            "import predictionio_tpu\n"
+            "print('ran with', sys.argv[1])\n"
+        )
+        code, out = run(capsys, "run", str(script), "--engine-dir", str(tmp_path),
+                        "hello")
+        assert code == 0
+        assert "ran with hello" in out

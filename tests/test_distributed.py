@@ -91,8 +91,15 @@ def test_passthrough_parses_distributed_flags():
 
 
 def test_hybrid_mesh_oversubscription_is_clear():
-    with pytest.raises(ValueError, match="needs 32 devices, have 8"):
+    with pytest.raises(ValueError, match="covers 32 device"):
         build_mesh([4, 2], ("data", "model"), dcn_mesh_shape=[4, 1])
+
+
+def test_hybrid_mesh_undersubscription_is_clear():
+    # under-subscribed hybrid shapes would die deep inside jax's
+    # create_hybrid_device_mesh; the guard must catch them first
+    with pytest.raises(ValueError, match="covers 2 device"):
+        build_mesh([2, 1], ("data", "model"), dcn_mesh_shape=[1, 1])
 
 
 def test_launch_conf_not_persisted():

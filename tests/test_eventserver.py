@@ -236,6 +236,35 @@ class TestWebhooks:
         )
         assert bad.status_code == 400
 
+    def test_mailchimp_webhook(self, server):
+        base, key = server
+        r = requests.post(
+            f"{base}/webhooks/mailchimp.json", params={"accessKey": key},
+            data={
+                "type": "subscribe",
+                "fired_at": "2023-03-26 21:35:57",
+                "data[id]": "8a25ff1d98",
+                "data[list_id]": "a6b5da1054",
+                "data[email]": "api@mailchimp.com",
+            },
+        )
+        assert r.status_code == 201
+        found = requests.get(
+            f"{base}/events.json", params={"accessKey": key, "event": "subscribe"}
+        ).json()
+        assert found[0]["entityType"] == "user"
+        assert found[0]["entityId"] == "8a25ff1d98"
+        assert found[0]["targetEntityType"] == "list"
+        assert found[0]["targetEntityId"] == "a6b5da1054"
+        assert found[0]["properties"]["email"] == "api@mailchimp.com"
+        assert found[0]["eventTime"].startswith("2023-03-26T21:35:57")
+
+        bad = requests.post(
+            f"{base}/webhooks/mailchimp.json", params={"accessKey": key},
+            data={"type": "weird"},
+        )
+        assert bad.status_code == 400
+
     def test_form_webhook_and_unknown(self, server):
         base, key = server
         r = requests.post(

@@ -1,0 +1,51 @@
+"""pypio bridge tests (reference python/pypio scope, SURVEY.md section 2.5 #35)."""
+
+import pytest
+
+from predictionio_tpu import pypio
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.tools.app_ops import create_app
+
+
+@pytest.fixture()
+def app(storage_env):
+    record, _access_key = create_app("Shop")
+    levents = storage_env.get_l_events()
+    for user, item, rating in [("u1", "i1", 4.0), ("u1", "i2", 2.0), ("u2", "i1", 5.0)]:
+        levents.insert(
+            Event(
+                event="rate",
+                entity_type="user",
+                entity_id=user,
+                target_entity_type="item",
+                target_entity_id=item,
+                properties=DataMap({"rating": rating}),
+            ),
+            app_id=record.id,
+        )
+    return record
+
+
+class TestPypio:
+    def test_requires_init(self, app):
+        pypio._initialized = False
+        with pytest.raises(RuntimeError, match="init"):
+            pypio.find_events("Shop")
+
+    def test_find_events_columnar(self, app):
+        pypio.init()
+        ds = pypio.find_events("Shop")
+        assert len(ds) == 3
+        assert set(ds.entity_id_vocab) == {"u1", "u2"}
+
+        rows = pypio.find_events_rows("Shop", event_names=["rate"])
+        assert len(rows) == 3
+        assert rows[0]["event"] == "rate"
+
+    def test_save_and_load_model(self, app):
+        pypio.init()
+        blob_id = pypio.save_model({"factors": [1, 2, 3]})
+        assert pypio.load_model(blob_id) == {"factors": [1, 2, 3]}
+        with pytest.raises(KeyError):
+            pypio.load_model("missing")
