@@ -40,6 +40,9 @@ _BACKENDS: dict[str, str] = {
     "sqlite": "predictionio_tpu.data.storage.sqlite",
     "memory": "predictionio_tpu.data.storage.memory",
     "localfs": "predictionio_tpu.data.storage.localfs",
+    "postgres": "predictionio_tpu.data.storage.postgres",
+    # reference TYPE name for the scalikejdbc module; postgres URL required
+    "jdbc": "predictionio_tpu.data.storage.postgres",
 }
 
 _REPOS = ("METADATA", "EVENTDATA", "MODELDATA")

@@ -1,0 +1,5 @@
+"""PostgreSQL storage backend (reference JDBC-module parity)."""
+
+from predictionio_tpu.data.storage.postgres.client import StorageClient
+
+__all__ = ["StorageClient"]

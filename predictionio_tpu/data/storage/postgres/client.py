@@ -1,0 +1,223 @@
+"""PostgreSQL implementations of every DAO contract.
+
+Parity role of the reference's scalikejdbc module ``storage/jdbc/.../
+JDBC{Apps,AccessKeys,Channels,EngineInstances,EvaluationInstances,LEvents,
+PEvents,Models}.scala`` (apache/predictionio layout, unverified -- SURVEY.md
+section 2.2 #10): a full-stack backend (events + metadata + models) for
+PostgreSQL, with DDL auto-create on first connect. The DAO logic is shared
+with the sqlite backend via ``sql_common``; only the connection, paramstyle,
+and dialect DDL live here.
+
+Configuration (reference env-var contract, SURVEY.md section 5.6):
+
+    PIO_STORAGE_SOURCES_PGSQL_TYPE=postgres   (or: jdbc)
+    PIO_STORAGE_SOURCES_PGSQL_URL=jdbc:postgresql://host:5432/pio
+    PIO_STORAGE_SOURCES_PGSQL_USERNAME=pio
+    PIO_STORAGE_SOURCES_PGSQL_PASSWORD=...
+
+``URL`` accepts both ``jdbc:postgresql://`` (reference form) and plain
+``postgresql://`` URLs; HOST/PORT/DBNAME properties may be used instead.
+Driver: psycopg2 (optional dependency -- a clear error is raised when it is
+not installed; nothing else in the framework depends on it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+from urllib.parse import parse_qs, urlparse
+
+from predictionio_tpu.data.storage import sql_common
+from predictionio_tpu.data.storage.base import StorageClientConfig
+
+_SCHEMA_STATEMENTS = [
+    """CREATE TABLE IF NOT EXISTS apps (
+      id BIGSERIAL PRIMARY KEY,
+      name TEXT UNIQUE NOT NULL,
+      description TEXT NOT NULL DEFAULT ''
+    )""",
+    """CREATE TABLE IF NOT EXISTS channels (
+      id BIGSERIAL PRIMARY KEY,
+      name TEXT NOT NULL,
+      app_id BIGINT NOT NULL,
+      UNIQUE(app_id, name)
+    )""",
+    """CREATE TABLE IF NOT EXISTS access_keys (
+      key TEXT PRIMARY KEY,
+      app_id BIGINT NOT NULL,
+      events TEXT NOT NULL DEFAULT '[]'
+    )""",
+    """CREATE TABLE IF NOT EXISTS engine_instances (
+      id TEXT PRIMARY KEY,
+      status TEXT NOT NULL,
+      start_time TEXT NOT NULL,
+      end_time TEXT,
+      engine_id TEXT NOT NULL,
+      engine_version TEXT NOT NULL,
+      engine_variant TEXT NOT NULL,
+      engine_factory TEXT NOT NULL,
+      batch TEXT NOT NULL DEFAULT '',
+      env TEXT NOT NULL DEFAULT '{}',
+      runtime_conf TEXT NOT NULL DEFAULT '{}',
+      data_source_params TEXT NOT NULL DEFAULT '{}',
+      preparator_params TEXT NOT NULL DEFAULT '{}',
+      algorithms_params TEXT NOT NULL DEFAULT '[]',
+      serving_params TEXT NOT NULL DEFAULT '{}'
+    )""",
+    """CREATE TABLE IF NOT EXISTS evaluation_instances (
+      id TEXT PRIMARY KEY,
+      status TEXT NOT NULL,
+      start_time TEXT NOT NULL,
+      end_time TEXT,
+      evaluation_class TEXT NOT NULL,
+      engine_params_generator_class TEXT NOT NULL,
+      batch TEXT NOT NULL DEFAULT '',
+      env TEXT NOT NULL DEFAULT '{}',
+      evaluator_results TEXT NOT NULL DEFAULT '',
+      evaluator_results_html TEXT NOT NULL DEFAULT '',
+      evaluator_results_json TEXT NOT NULL DEFAULT ''
+    )""",
+    """CREATE TABLE IF NOT EXISTS models (
+      id TEXT PRIMARY KEY,
+      models BYTEA NOT NULL
+    )""",
+    """CREATE TABLE IF NOT EXISTS event_channels (
+      app_id BIGINT NOT NULL,
+      channel_id BIGINT NOT NULL,
+      PRIMARY KEY (app_id, channel_id)
+    )""",
+    """CREATE TABLE IF NOT EXISTS events (
+      event_id TEXT NOT NULL,
+      app_id BIGINT NOT NULL,
+      channel_id BIGINT NOT NULL,
+      event TEXT NOT NULL,
+      entity_type TEXT NOT NULL,
+      entity_id TEXT NOT NULL,
+      target_entity_type TEXT,
+      target_entity_id TEXT,
+      properties TEXT NOT NULL DEFAULT '{}',
+      event_time TEXT NOT NULL,
+      event_time_ms BIGINT NOT NULL,
+      pr_id TEXT,
+      creation_time TEXT NOT NULL,
+      PRIMARY KEY (app_id, channel_id, event_id)
+    )""",
+    """CREATE INDEX IF NOT EXISTS idx_events_scan
+      ON events (app_id, channel_id, entity_type, event_time_ms)""",
+    """CREATE INDEX IF NOT EXISTS idx_events_name
+      ON events (app_id, channel_id, event, event_time_ms)""",
+]
+
+
+def parse_connection_properties(props: dict[str, str]) -> dict:
+    """URL/HOST/PORT/DBNAME/USERNAME/PASSWORD properties -> psycopg2 kwargs.
+
+    Accepts the reference's ``jdbc:postgresql://...`` URL form verbatim.
+    """
+    kwargs: dict = {}
+    url = props.get("URL", "")
+    if url:
+        if url.startswith("jdbc:"):
+            url = url[len("jdbc:"):]
+        parsed = urlparse(url)
+        if parsed.scheme not in ("postgresql", "postgres"):
+            raise ValueError(
+                f"unsupported URL scheme {parsed.scheme!r} for postgres storage"
+            )
+        if parsed.hostname:
+            kwargs["host"] = parsed.hostname
+        if parsed.port:
+            kwargs["port"] = parsed.port
+        dbname = (parsed.path or "").lstrip("/")
+        if dbname:
+            kwargs["dbname"] = dbname
+        if parsed.username:
+            kwargs["user"] = parsed.username
+        if parsed.password:
+            kwargs["password"] = parsed.password
+        # JDBC-style query params: ?user=..&password=..&sslmode=.. -- the
+        # standard credential form of the reference's URL contract
+        for key, values in parse_qs(parsed.query).items():
+            if key in ("user", "password", "sslmode", "connect_timeout"):
+                kwargs[key] = values[-1]
+    if props.get("HOST"):
+        kwargs["host"] = props["HOST"]
+    if props.get("PORT"):
+        kwargs["port"] = int(props["PORT"])
+    if props.get("DBNAME"):
+        kwargs["dbname"] = props["DBNAME"]
+    if props.get("USERNAME"):
+        kwargs["user"] = props["USERNAME"]
+    if props.get("PASSWORD"):
+        kwargs["password"] = props["PASSWORD"]
+    kwargs.setdefault("host", "localhost")
+    kwargs.setdefault("port", 5432)
+    kwargs.setdefault("dbname", "pio")
+    return kwargs
+
+
+class StorageClient(sql_common.SQLStorageClient):
+    """Thread-safe psycopg2 connection with DDL auto-create."""
+
+    placeholder = "%s"
+    INSERT_IGNORE_EVENT_CHANNELS = (
+        "INSERT INTO event_channels (app_id, channel_id) VALUES (?, ?)"
+        " ON CONFLICT DO NOTHING"
+    )
+    UPSERT_MODEL = (
+        "INSERT INTO models (id, models) VALUES (?, ?)"
+        " ON CONFLICT (id) DO UPDATE SET models = EXCLUDED.models"
+    )
+
+    def __init__(self, config: StorageClientConfig):
+        super().__init__(config)
+        try:
+            import psycopg2
+        except ImportError as exc:
+            raise RuntimeError(
+                "the postgres storage backend requires psycopg2; install it or"
+                " switch PIO_STORAGE_SOURCES_*_TYPE to 'sqlite'"
+            ) from exc
+        kwargs = parse_connection_properties(config.properties)
+        self._conn = psycopg2.connect(**kwargs)
+        self._lock = threading.RLock()
+        # `with conn:` = one transaction (commit on exit, rollback on error),
+        # so batch_insert keeps the sqlite backend's all-or-nothing semantics
+        with self._lock, self._conn, self._conn.cursor() as cur:
+            for stmt in _SCHEMA_STATEMENTS:
+                cur.execute(stmt)
+
+    def execute(self, sql: str, params: tuple = ()):
+        with self._lock, self._conn, self._conn.cursor() as cur:
+            cur.execute(sql, params)
+            return _Result(cur.rowcount)
+
+    def executemany(self, sql: str, rows: list[tuple]):
+        with self._lock, self._conn, self._conn.cursor() as cur:
+            cur.executemany(sql, rows)
+            return _Result(cur.rowcount)
+
+    def insert_returning_id(self, sql: str, params: tuple) -> int:
+        with self._lock, self._conn, self._conn.cursor() as cur:
+            cur.execute(sql + " RETURNING id", params)
+            return cur.fetchone()[0]
+
+    def query(self, sql: str, params: tuple = ()) -> list[tuple]:
+        with self._lock, self._conn, self._conn.cursor() as cur:
+            cur.execute(sql, params)
+            return cur.fetchall()
+
+    def query_iter(self, sql: str, params: tuple = ()) -> Iterator[tuple]:
+        # a default psycopg2 cursor pulls the whole result client-side at
+        # execute() anyway, so materialize under the lock and yield outside
+        # it -- never holding the client-wide lock across consumer yields
+        yield from self.query(sql, params)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class _Result:
+    def __init__(self, rowcount: int):
+        self.rowcount = rowcount

@@ -1,27 +1,17 @@
-"""SQLite implementations of every DAO contract."""
+"""SQLite storage backend: the zero-config dev default.
+
+Parity role of the reference's JDBC quickstart path (SURVEY.md section 2.2
+#10); the DAO logic itself lives in ``sql_common`` and is shared with the
+postgres backend.
+"""
 
 from __future__ import annotations
 
-import datetime as _dt
-import json
-import secrets
 import sqlite3
 import threading
-import uuid
-from typing import Iterable, Iterator, Optional
 
-from predictionio_tpu.data.datamap import DataMap
-from predictionio_tpu.data.event import Event
-from predictionio_tpu.data.storage import base
-from predictionio_tpu.data.storage.base import (
-    AccessKey,
-    App,
-    Channel,
-    EngineInstance,
-    EvaluationInstance,
-    Model,
-    StorageClientConfig,
-)
+from predictionio_tpu.data.storage import sql_common
+from predictionio_tpu.data.storage.base import StorageClientConfig
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS apps (
@@ -101,33 +91,11 @@ CREATE INDEX IF NOT EXISTS idx_events_name
   ON events (app_id, channel_id, event, event_time_ms);
 """
 
-#: channel_id column value for the default channel (reference uses None).
-_DEFAULT_CHANNEL = 0
 
-
-def _ts_to_str(ts: _dt.datetime | None) -> str | None:
-    # normalize to UTC with fixed precision so text ORDER BY is chronological
-    if ts is None:
-        return None
-    if ts.tzinfo is None:
-        ts = ts.replace(tzinfo=_dt.timezone.utc)
-    return ts.astimezone(_dt.timezone.utc).isoformat(timespec="microseconds")
-
-
-def _ts_from_str(s: str | None) -> _dt.datetime | None:
-    return _dt.datetime.fromisoformat(s) if s else None
-
-
-def _ts_ms(ts: _dt.datetime) -> int:
-    # same naive-means-UTC rule as Event.__post_init__, so stored values and
-    # find() bounds agree on any host timezone
-    if ts.tzinfo is None:
-        ts = ts.replace(tzinfo=_dt.timezone.utc)
-    return int(ts.timestamp() * 1000)
-
-
-class StorageClient(base.BaseStorageClient):
+class StorageClient(sql_common.SQLStorageClient):
     """Thread-safe sqlite connection; one file holds all repositories."""
+
+    placeholder = "?"
 
     def __init__(self, config: StorageClientConfig):
         super().__init__(config)
@@ -140,17 +108,6 @@ class StorageClient(base.BaseStorageClient):
         with self._lock, self._conn:
             self._conn.executescript(_SCHEMA)
 
-    def get_dao(self, repo: str):
-        return {
-            "apps": SQLiteApps,
-            "channels": SQLiteChannels,
-            "access_keys": SQLiteAccessKeys,
-            "engine_instances": SQLiteEngineInstances,
-            "evaluation_instances": SQLiteEvaluationInstances,
-            "models": SQLiteModels,
-            "events": SQLiteLEvents,
-        }[repo](self)
-
     def execute(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
         with self._lock, self._conn:
             return self._conn.execute(sql, params)
@@ -158,6 +115,9 @@ class StorageClient(base.BaseStorageClient):
     def executemany(self, sql: str, rows: list[tuple]) -> sqlite3.Cursor:
         with self._lock, self._conn:
             return self._conn.executemany(sql, rows)
+
+    def insert_returning_id(self, sql: str, params: tuple) -> int:
+        return self.execute(sql, params).lastrowid
 
     def query(self, sql: str, params: tuple = ()) -> list[tuple]:
         with self._lock:
@@ -188,480 +148,3 @@ class StorageClient(base.BaseStorageClient):
     def close(self) -> None:
         with self._lock:
             self._conn.close()
-
-
-class SQLiteApps(base.Apps):
-    def __init__(self, client: StorageClient):
-        self.c = client
-
-    def insert(self, app: App) -> int:
-        cur = self.c.execute(
-            "INSERT INTO apps (name, description) VALUES (?, ?)",
-            (app.name, app.description),
-        )
-        app.id = cur.lastrowid
-        return app.id
-
-    def get(self, app_id: int) -> Optional[App]:
-        rows = self.c.query("SELECT id, name, description FROM apps WHERE id=?", (app_id,))
-        return App(id=rows[0][0], name=rows[0][1], description=rows[0][2]) if rows else None
-
-    def get_by_name(self, name: str) -> Optional[App]:
-        rows = self.c.query("SELECT id, name, description FROM apps WHERE name=?", (name,))
-        return App(id=rows[0][0], name=rows[0][1], description=rows[0][2]) if rows else None
-
-    def get_all(self) -> list[App]:
-        rows = self.c.query("SELECT id, name, description FROM apps ORDER BY id")
-        return [App(id=r[0], name=r[1], description=r[2]) for r in rows]
-
-    def update(self, app: App) -> None:
-        self.c.execute(
-            "UPDATE apps SET name=?, description=? WHERE id=?",
-            (app.name, app.description, app.id),
-        )
-
-    def delete(self, app_id: int) -> None:
-        self.c.execute("DELETE FROM apps WHERE id=?", (app_id,))
-
-
-class SQLiteChannels(base.Channels):
-    def __init__(self, client: StorageClient):
-        self.c = client
-
-    def insert(self, channel: Channel) -> int:
-        cur = self.c.execute(
-            "INSERT INTO channels (name, app_id) VALUES (?, ?)",
-            (channel.name, channel.app_id),
-        )
-        channel.id = cur.lastrowid
-        return channel.id
-
-    def get(self, channel_id: int) -> Optional[Channel]:
-        rows = self.c.query("SELECT id, name, app_id FROM channels WHERE id=?", (channel_id,))
-        return Channel(id=rows[0][0], name=rows[0][1], app_id=rows[0][2]) if rows else None
-
-    def get_by_app(self, app_id: int) -> list[Channel]:
-        rows = self.c.query(
-            "SELECT id, name, app_id FROM channels WHERE app_id=? ORDER BY id", (app_id,)
-        )
-        return [Channel(id=r[0], name=r[1], app_id=r[2]) for r in rows]
-
-    def delete(self, channel_id: int) -> None:
-        self.c.execute("DELETE FROM channels WHERE id=?", (channel_id,))
-
-
-class SQLiteAccessKeys(base.AccessKeys):
-    def __init__(self, client: StorageClient):
-        self.c = client
-
-    def insert(self, access_key: AccessKey) -> str:
-        key = access_key.key or secrets.token_urlsafe(48)
-        self.c.execute(
-            "INSERT INTO access_keys (key, app_id, events) VALUES (?, ?, ?)",
-            (key, access_key.app_id, json.dumps(access_key.events)),
-        )
-        access_key.key = key
-        return key
-
-    def get(self, key: str) -> Optional[AccessKey]:
-        rows = self.c.query(
-            "SELECT key, app_id, events FROM access_keys WHERE key=?", (key,)
-        )
-        if not rows:
-            return None
-        return AccessKey(key=rows[0][0], app_id=rows[0][1], events=json.loads(rows[0][2]))
-
-    def get_all(self) -> list[AccessKey]:
-        rows = self.c.query("SELECT key, app_id, events FROM access_keys")
-        return [AccessKey(key=r[0], app_id=r[1], events=json.loads(r[2])) for r in rows]
-
-    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
-        rows = self.c.query(
-            "SELECT key, app_id, events FROM access_keys WHERE app_id=?", (app_id,)
-        )
-        return [AccessKey(key=r[0], app_id=r[1], events=json.loads(r[2])) for r in rows]
-
-    def update(self, access_key: AccessKey) -> None:
-        self.c.execute(
-            "UPDATE access_keys SET app_id=?, events=? WHERE key=?",
-            (access_key.app_id, json.dumps(access_key.events), access_key.key),
-        )
-
-    def delete(self, key: str) -> None:
-        self.c.execute("DELETE FROM access_keys WHERE key=?", (key,))
-
-
-class SQLiteEngineInstances(base.EngineInstances):
-    _COLS = (
-        "id, status, start_time, end_time, engine_id, engine_version, engine_variant,"
-        " engine_factory, batch, env, runtime_conf, data_source_params,"
-        " preparator_params, algorithms_params, serving_params"
-    )
-
-    def __init__(self, client: StorageClient):
-        self.c = client
-
-    def _row_to_instance(self, r: tuple) -> EngineInstance:
-        return EngineInstance(
-            id=r[0],
-            status=r[1],
-            start_time=_ts_from_str(r[2]),
-            end_time=_ts_from_str(r[3]),
-            engine_id=r[4],
-            engine_version=r[5],
-            engine_variant=r[6],
-            engine_factory=r[7],
-            batch=r[8],
-            env=json.loads(r[9]),
-            runtime_conf=json.loads(r[10]),
-            data_source_params=r[11],
-            preparator_params=r[12],
-            algorithms_params=r[13],
-            serving_params=r[14],
-        )
-
-    def insert(self, instance: EngineInstance) -> str:
-        instance.id = instance.id or uuid.uuid4().hex
-        self.c.execute(
-            f"INSERT INTO engine_instances ({self._COLS}) VALUES "
-            "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
-            (
-                instance.id,
-                instance.status,
-                _ts_to_str(instance.start_time),
-                _ts_to_str(instance.end_time),
-                instance.engine_id,
-                instance.engine_version,
-                instance.engine_variant,
-                instance.engine_factory,
-                instance.batch,
-                json.dumps(instance.env),
-                json.dumps(instance.runtime_conf),
-                instance.data_source_params,
-                instance.preparator_params,
-                instance.algorithms_params,
-                instance.serving_params,
-            ),
-        )
-        return instance.id
-
-    def get(self, instance_id: str) -> Optional[EngineInstance]:
-        rows = self.c.query(
-            f"SELECT {self._COLS} FROM engine_instances WHERE id=?", (instance_id,)
-        )
-        return self._row_to_instance(rows[0]) if rows else None
-
-    def get_all(self) -> list[EngineInstance]:
-        rows = self.c.query(
-            f"SELECT {self._COLS} FROM engine_instances ORDER BY start_time DESC"
-        )
-        return [self._row_to_instance(r) for r in rows]
-
-    def get_completed(
-        self, engine_id: str, engine_version: str, engine_variant: str
-    ) -> list[EngineInstance]:
-        rows = self.c.query(
-            f"SELECT {self._COLS} FROM engine_instances WHERE status=? AND engine_id=?"
-            " AND engine_version=? AND engine_variant=? ORDER BY start_time DESC",
-            (base.STATUS_COMPLETED, engine_id, engine_version, engine_variant),
-        )
-        return [self._row_to_instance(r) for r in rows]
-
-    def get_latest_completed(
-        self, engine_id: str, engine_version: str, engine_variant: str
-    ) -> Optional[EngineInstance]:
-        completed = self.get_completed(engine_id, engine_version, engine_variant)
-        return completed[0] if completed else None
-
-    def update(self, instance: EngineInstance) -> None:
-        self.c.execute(
-            "UPDATE engine_instances SET status=?, start_time=?, end_time=?,"
-            " engine_id=?, engine_version=?, engine_variant=?, engine_factory=?,"
-            " batch=?, env=?, runtime_conf=?, data_source_params=?,"
-            " preparator_params=?, algorithms_params=?, serving_params=? WHERE id=?",
-            (
-                instance.status,
-                _ts_to_str(instance.start_time),
-                _ts_to_str(instance.end_time),
-                instance.engine_id,
-                instance.engine_version,
-                instance.engine_variant,
-                instance.engine_factory,
-                instance.batch,
-                json.dumps(instance.env),
-                json.dumps(instance.runtime_conf),
-                instance.data_source_params,
-                instance.preparator_params,
-                instance.algorithms_params,
-                instance.serving_params,
-                instance.id,
-            ),
-        )
-
-    def delete(self, instance_id: str) -> None:
-        self.c.execute("DELETE FROM engine_instances WHERE id=?", (instance_id,))
-
-
-class SQLiteEvaluationInstances(base.EvaluationInstances):
-    _COLS = (
-        "id, status, start_time, end_time, evaluation_class,"
-        " engine_params_generator_class, batch, env, evaluator_results,"
-        " evaluator_results_html, evaluator_results_json"
-    )
-
-    def __init__(self, client: StorageClient):
-        self.c = client
-
-    def _row_to_instance(self, r: tuple) -> EvaluationInstance:
-        return EvaluationInstance(
-            id=r[0],
-            status=r[1],
-            start_time=_ts_from_str(r[2]),
-            end_time=_ts_from_str(r[3]),
-            evaluation_class=r[4],
-            engine_params_generator_class=r[5],
-            batch=r[6],
-            env=json.loads(r[7]),
-            evaluator_results=r[8],
-            evaluator_results_html=r[9],
-            evaluator_results_json=r[10],
-        )
-
-    def insert(self, instance: EvaluationInstance) -> str:
-        instance.id = instance.id or uuid.uuid4().hex
-        self.c.execute(
-            f"INSERT INTO evaluation_instances ({self._COLS}) VALUES"
-            " (?,?,?,?,?,?,?,?,?,?,?)",
-            (
-                instance.id,
-                instance.status,
-                _ts_to_str(instance.start_time),
-                _ts_to_str(instance.end_time),
-                instance.evaluation_class,
-                instance.engine_params_generator_class,
-                instance.batch,
-                json.dumps(instance.env),
-                instance.evaluator_results,
-                instance.evaluator_results_html,
-                instance.evaluator_results_json,
-            ),
-        )
-        return instance.id
-
-    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
-        rows = self.c.query(
-            f"SELECT {self._COLS} FROM evaluation_instances WHERE id=?", (instance_id,)
-        )
-        return self._row_to_instance(rows[0]) if rows else None
-
-    def get_all(self) -> list[EvaluationInstance]:
-        rows = self.c.query(
-            f"SELECT {self._COLS} FROM evaluation_instances ORDER BY start_time DESC"
-        )
-        return [self._row_to_instance(r) for r in rows]
-
-    def get_completed(self) -> list[EvaluationInstance]:
-        rows = self.c.query(
-            f"SELECT {self._COLS} FROM evaluation_instances WHERE status=?"
-            " ORDER BY start_time DESC",
-            (base.STATUS_COMPLETED,),
-        )
-        return [self._row_to_instance(r) for r in rows]
-
-    def update(self, instance: EvaluationInstance) -> None:
-        self.c.execute(
-            "UPDATE evaluation_instances SET status=?, start_time=?, end_time=?,"
-            " evaluation_class=?, engine_params_generator_class=?, batch=?, env=?,"
-            " evaluator_results=?, evaluator_results_html=?, evaluator_results_json=?"
-            " WHERE id=?",
-            (
-                instance.status,
-                _ts_to_str(instance.start_time),
-                _ts_to_str(instance.end_time),
-                instance.evaluation_class,
-                instance.engine_params_generator_class,
-                instance.batch,
-                json.dumps(instance.env),
-                instance.evaluator_results,
-                instance.evaluator_results_html,
-                instance.evaluator_results_json,
-                instance.id,
-            ),
-        )
-
-    def delete(self, instance_id: str) -> None:
-        self.c.execute("DELETE FROM evaluation_instances WHERE id=?", (instance_id,))
-
-
-class SQLiteModels(base.Models):
-    def __init__(self, client: StorageClient):
-        self.c = client
-
-    def insert(self, model: Model) -> None:
-        self.c.execute(
-            "INSERT OR REPLACE INTO models (id, models) VALUES (?, ?)",
-            (model.id, model.models),
-        )
-
-    def get(self, model_id: str) -> Optional[Model]:
-        rows = self.c.query("SELECT id, models FROM models WHERE id=?", (model_id,))
-        return Model(id=rows[0][0], models=rows[0][1]) if rows else None
-
-    def delete(self, model_id: str) -> None:
-        self.c.execute("DELETE FROM models WHERE id=?", (model_id,))
-
-
-class SQLiteLEvents(base.LEvents):
-    def __init__(self, client: StorageClient):
-        self.c = client
-
-    @staticmethod
-    def _ch(channel_id: int | None) -> int:
-        return _DEFAULT_CHANNEL if channel_id is None else channel_id
-
-    def init_channel(self, app_id: int, channel_id: int | None = None) -> bool:
-        self.c.execute(
-            "INSERT OR IGNORE INTO event_channels (app_id, channel_id) VALUES (?, ?)",
-            (app_id, self._ch(channel_id)),
-        )
-        return True
-
-    def remove_channel(self, app_id: int, channel_id: int | None = None) -> bool:
-        ch = self._ch(channel_id)
-        self.c.execute(
-            "DELETE FROM events WHERE app_id=? AND channel_id=?", (app_id, ch)
-        )
-        self.c.execute(
-            "DELETE FROM event_channels WHERE app_id=? AND channel_id=?", (app_id, ch)
-        )
-        return True
-
-    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
-        return self.batch_insert([event], app_id, channel_id)[0]
-
-    def batch_insert(
-        self, events: Iterable[Event], app_id: int, channel_id: int | None = None
-    ) -> list[str]:
-        ch = self._ch(channel_id)
-        rows, ids = [], []
-        for ev in events:
-            ev = ev if ev.event_id else ev.with_id()
-            ids.append(ev.event_id)
-            rows.append(
-                (
-                    ev.event_id,
-                    app_id,
-                    ch,
-                    ev.event,
-                    ev.entity_type,
-                    ev.entity_id,
-                    ev.target_entity_type,
-                    ev.target_entity_id,
-                    json.dumps(ev.properties.to_dict()),
-                    ev.event_time.isoformat(),
-                    _ts_ms(ev.event_time),
-                    ev.pr_id,
-                    ev.creation_time.isoformat(),
-                )
-            )
-        # plain INSERT: the event log is append-only, a duplicate event_id is
-        # a caller bug and must surface as an IntegrityError, not overwrite
-        self.c.executemany(
-            "INSERT INTO events (event_id, app_id, channel_id, event,"
-            " entity_type, entity_id, target_entity_type, target_entity_id,"
-            " properties, event_time, event_time_ms, pr_id, creation_time)"
-            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-            rows,
-        )
-        return ids
-
-    @staticmethod
-    def _row_to_event(r: tuple) -> Event:
-        return Event(
-            event_id=r[0],
-            event=r[1],
-            entity_type=r[2],
-            entity_id=r[3],
-            target_entity_type=r[4],
-            target_entity_id=r[5],
-            properties=DataMap(json.loads(r[6])),
-            event_time=_dt.datetime.fromisoformat(r[7]),
-            pr_id=r[8],
-            creation_time=_dt.datetime.fromisoformat(r[9]),
-        )
-
-    _EVENT_COLS = (
-        "event_id, event, entity_type, entity_id, target_entity_type,"
-        " target_entity_id, properties, event_time, pr_id, creation_time"
-    )
-
-    def get(
-        self, event_id: str, app_id: int, channel_id: int | None = None
-    ) -> Optional[Event]:
-        rows = self.c.query(
-            f"SELECT {self._EVENT_COLS} FROM events"
-            " WHERE app_id=? AND channel_id=? AND event_id=?",
-            (app_id, self._ch(channel_id), event_id),
-        )
-        return self._row_to_event(rows[0]) if rows else None
-
-    def delete(
-        self, event_id: str, app_id: int, channel_id: int | None = None
-    ) -> bool:
-        cur = self.c.execute(
-            "DELETE FROM events WHERE app_id=? AND channel_id=? AND event_id=?",
-            (app_id, self._ch(channel_id), event_id),
-        )
-        return cur.rowcount > 0
-
-    def find(
-        self,
-        app_id: int,
-        channel_id: int | None = None,
-        start_time: _dt.datetime | None = None,
-        until_time: _dt.datetime | None = None,
-        entity_type: str | None = None,
-        entity_id: str | None = None,
-        event_names: list[str] | None = None,
-        target_entity_type=...,
-        target_entity_id=...,
-        limit: int | None = None,
-        reversed: bool = False,
-    ) -> Iterator[Event]:
-        sql = [
-            f"SELECT {self._EVENT_COLS} FROM events WHERE app_id=? AND channel_id=?"
-        ]
-        params: list = [app_id, self._ch(channel_id)]
-        if start_time is not None:
-            sql.append("AND event_time_ms >= ?")
-            params.append(_ts_ms(start_time))
-        if until_time is not None:
-            sql.append("AND event_time_ms < ?")
-            params.append(_ts_ms(until_time))
-        if entity_type is not None:
-            sql.append("AND entity_type = ?")
-            params.append(entity_type)
-        if entity_id is not None:
-            sql.append("AND entity_id = ?")
-            params.append(entity_id)
-        if event_names:
-            sql.append(f"AND event IN ({','.join('?' * len(event_names))})")
-            params.extend(event_names)
-        if target_entity_type is not ...:
-            if target_entity_type is None:
-                sql.append("AND target_entity_type IS NULL")
-            else:
-                sql.append("AND target_entity_type = ?")
-                params.append(target_entity_type)
-        if target_entity_id is not ...:
-            if target_entity_id is None:
-                sql.append("AND target_entity_id IS NULL")
-            else:
-                sql.append("AND target_entity_id = ?")
-                params.append(target_entity_id)
-        sql.append(f"ORDER BY event_time_ms {'DESC' if reversed else 'ASC'}")
-        if limit is not None and limit >= 0:
-            sql.append("LIMIT ?")
-            params.append(limit)
-        for r in self.c.query_iter(" ".join(sql), tuple(params)):
-            yield self._row_to_event(r)

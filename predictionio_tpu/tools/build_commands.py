@@ -41,7 +41,9 @@ def register(sub: argparse._SubParsersAction) -> None:
     )
     run.add_argument("main", help="path to a .py file or a dotted module name")
     run.add_argument("--engine-dir", default=".", help="added to sys.path")
-    run.add_argument("args", nargs="*", help="argv passed to the target")
+    # REMAINDER: everything after `main` belongs to the target, including
+    # option-style tokens like --epochs
+    run.add_argument("args", nargs=argparse.REMAINDER, help="argv passed to the target")
     run.set_defaults(func=cmd_run)
 
     template = sub.add_parser("template", help="list or scaffold engine templates")
@@ -192,7 +194,7 @@ def cmd_template_get(args: argparse.Namespace) -> int:
         print(f"Error: no bundled template named {args.name!r}; try `pio template list`")
         return 1
     dst = os.path.abspath(args.directory)
-    if os.path.exists(dst) and os.listdir(dst):
+    if os.path.exists(dst) and (not os.path.isdir(dst) or os.listdir(dst)):
         print(f"Error: destination {dst} exists and is not empty")
         return 1
     shutil.copytree(src, dst, dirs_exist_ok=True)

@@ -113,7 +113,23 @@ class TestBuildVerbs:
             "import predictionio_tpu\n"
             "print('ran with', sys.argv[1])\n"
         )
-        code, out = run(capsys, "run", str(script), "--engine-dir", str(tmp_path),
+        code, out = run(capsys, "run", "--engine-dir", str(tmp_path), str(script),
                         "hello")
         assert code == 0
         assert "ran with hello" in out
+
+    def test_run_forwards_option_style_args(self, storage_env, tmp_path, capsys):
+        script = tmp_path / "main.py"
+        script.write_text("import sys\nprint('argv:', sys.argv[1:])\n")
+        code, out = run(capsys, "run", "--engine-dir", str(tmp_path), str(script),
+                        "--epochs", "5")
+        assert code == 0
+        assert "argv: ['--epochs', '5']" in out
+
+    def test_template_get_refuses_file_destination(self, storage_env, tmp_path, capsys):
+        target = tmp_path / "notes.txt"
+        target.write_text("keep me")
+        code, out = run(capsys, "template", "get", "recommendation", str(target))
+        assert code == 1
+        assert "exists" in out
+        assert target.read_text() == "keep me"
