@@ -95,8 +95,6 @@ CREATE INDEX IF NOT EXISTS idx_events_name
 class StorageClient(sql_common.SQLStorageClient):
     """Thread-safe sqlite connection; one file holds all repositories."""
 
-    placeholder = "?"
-
     def __init__(self, config: StorageClientConfig):
         super().__init__(config)
         path = config.properties.get("PATH", ":memory:")

@@ -1,0 +1,143 @@
+"""Native host-side kernels (C++, ctypes-loaded, numpy fallback).
+
+The compute path is JAX/XLA on device; this package is the native runtime
+around it -- host-side data packing that sits between the event store and
+``jax.device_put``. The library is compiled from the in-tree C++ source with
+g++ on first use and cached; every caller must handle ``load() -> None`` and
+fall back to the numpy implementation (no hard dependency on a toolchain).
+
+Env knobs:
+- ``PIO_NATIVE=0`` disables native kernels entirely (forces numpy paths);
+- ``PIO_NATIVE_CACHE`` overrides the build cache dir (default: a ``_build``
+  dir next to this file).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["csr_pack.cpp"]
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _cache_dir() -> str:
+    return os.environ.get("PIO_NATIVE_CACHE", os.path.join(_HERE, "_build"))
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for name in _SOURCES:
+        with open(os.path.join(_HERE, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build() -> str | None:
+    """Compile the shared library if its cached copy is stale; returns path."""
+    cache = _cache_dir()
+    lib_path = os.path.join(cache, f"libpio_native_{_source_digest()}.so")
+    if os.path.exists(lib_path):
+        return lib_path
+    sources = [os.path.join(_HERE, s) for s in _SOURCES]
+    tmp_path = None
+    try:
+        # unwritable cache dir (read-only install) must mean numpy fallback,
+        # not a crash, so dir/tempfile setup sits inside the try too
+        os.makedirs(cache, exist_ok=True)
+        # build to a temp name, then atomic-rename: concurrent builders race
+        # benignly instead of loading a half-written .so
+        fd, tmp_path = tempfile.mkstemp(suffix=".so", dir=cache)
+        os.close(fd)
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp_path, *sources]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp_path, lib_path)
+        return lib_path
+    except (subprocess.SubprocessError, OSError):
+        if tmp_path is not None:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+        return None
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, building it on first call; None when unavailable."""
+    global _lib, _load_failed
+    if os.environ.get("PIO_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        lib_path = _build()
+        if lib_path is None:
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError:
+            _load_failed = True
+            return None
+        import numpy as np
+        from numpy.ctypeslib import ndpointer
+
+        lib.pack_padded_csr.restype = ctypes.c_int64
+        lib.pack_padded_csr.argtypes = [
+            ndpointer(np.int64, flags="C_CONTIGUOUS"),   # rows
+            ndpointer(np.int64, flags="C_CONTIGUOUS"),   # cols
+            ndpointer(np.float32, flags="C_CONTIGUOUS"), # vals
+            ctypes.c_void_p,                             # times (nullable)
+            ctypes.c_int64,                              # n
+            ctypes.c_int64,                              # num_rows
+            ctypes.c_int64,                              # length
+            ctypes.c_int64,                              # padded_rows
+            ctypes.c_int64,                              # num_cols
+            ndpointer(np.int32, flags="C_CONTIGUOUS"),   # out_indices
+            ndpointer(np.float32, flags="C_CONTIGUOUS"), # out_values
+            ndpointer(np.float32, flags="C_CONTIGUOUS"), # out_mask
+        ]
+        _lib = lib
+        return _lib
+
+
+def pack_padded_csr_native(
+    rows, cols, vals, times, num_rows, length, padded_rows, num_cols,
+    indices, values, mask,
+) -> int | None:
+    """Run the native pack; returns truncated count, or None if unavailable
+    or the kernel rejected the input (caller falls back to numpy)."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    times_arg = None
+    if times is not None:
+        # float64 preserves float-timestamp ordering exactly as the numpy
+        # lexsort path sees it (int64 would truncate sub-unit differences)
+        times = np.ascontiguousarray(times, dtype=np.float64)
+        times_arg = times.ctypes.data_as(ctypes.c_void_p)
+    truncated = lib.pack_padded_csr(
+        np.ascontiguousarray(rows, dtype=np.int64),
+        np.ascontiguousarray(cols, dtype=np.int64),
+        np.ascontiguousarray(vals, dtype=np.float32),
+        times_arg,
+        rows.size,
+        num_rows,
+        length,
+        padded_rows,
+        num_cols,
+        indices,
+        values,
+        mask,
+    )
+    return None if truncated < 0 else int(truncated)

@@ -75,11 +75,6 @@ def pack_padded_csr(
             truncated=0,
         )
 
-    order = np.lexsort(
-        (times if times is not None else np.zeros_like(rows), rows)
-    )
-    rows, cols, vals = rows[order], cols[order], vals[order]
-
     counts = np.bincount(rows, minlength=num_rows)
     natural_max = int(counts.max())
     length = min(natural_max, max_len) if max_len else natural_max
@@ -89,6 +84,29 @@ def pack_padded_csr(
     indices = np.full((padded_rows, length), num_cols, dtype=np.int32)
     values = np.zeros((padded_rows, length), dtype=np.float32)
     mask = np.zeros((padded_rows, length), dtype=np.float32)
+
+    # native C++ pack: row-bucket counting sort, O(n) vs lexsort's O(n log n)
+    from predictionio_tpu import native
+
+    truncated = native.pack_padded_csr_native(
+        rows, cols, vals, times, num_rows, length, padded_rows, num_cols,
+        indices, values, mask,
+    )
+    if truncated is not None:
+        return PaddedCSR(
+            indices=indices,
+            values=values,
+            mask=mask,
+            num_rows=num_rows,
+            num_cols=num_cols,
+            truncated=truncated,
+        )
+
+    # numpy fallback (no toolchain / PIO_NATIVE=0)
+    order = np.lexsort(
+        (times if times is not None else np.zeros_like(rows), rows)
+    )
+    rows, cols, vals = rows[order], cols[order], vals[order]
 
     # within-row position of each (already row-sorted, time-ascending) entry
     row_starts = np.zeros(num_rows + 1, dtype=np.int64)

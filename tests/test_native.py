@@ -1,0 +1,107 @@
+"""Native C++ host-kernel tests: build, parity vs the numpy path, fallback."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu import native
+from predictionio_tpu.ops.ragged import pack_padded_csr
+
+
+def _random_coo(n, num_rows, num_cols, with_times, seed):
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, num_rows, size=n)
+    cols = rng.integers(0, num_cols, size=n)
+    vals = rng.random(n).astype(np.float32)
+    times = rng.integers(0, 10_000, size=n) if with_times else None
+    return rows, cols, vals, times
+
+
+def _pack_numpy(monkeypatch_env, *args, **kwargs):
+    return pack_padded_csr(*args, **kwargs)
+
+
+@pytest.fixture()
+def numpy_only(monkeypatch):
+    monkeypatch.setenv("PIO_NATIVE", "0")
+    yield
+
+
+class TestNativeBuild:
+    def test_library_builds_and_loads(self):
+        lib = native.load()
+        assert lib is not None, "g++ is in this image; the native build must work"
+
+
+class TestParity:
+    @pytest.mark.parametrize("with_times", [False, True])
+    @pytest.mark.parametrize("max_len", [None, 4])
+    def test_native_matches_numpy(self, monkeypatch, with_times, max_len):
+        rows, cols, vals, times = _random_coo(5_000, 64, 40, with_times, seed=7)
+        got = pack_padded_csr(rows, cols, vals, 64, 40, max_len=max_len, times=times)
+
+        monkeypatch.setenv("PIO_NATIVE", "0")
+        want = pack_padded_csr(rows, cols, vals, 64, 40, max_len=max_len, times=times)
+
+        np.testing.assert_array_equal(got.indices, want.indices)
+        np.testing.assert_array_equal(got.values, want.values)
+        np.testing.assert_array_equal(got.mask, want.mask)
+        assert got.truncated == want.truncated
+        assert got.num_rows == want.num_rows and got.num_cols == want.num_cols
+
+    def test_float_timestamps_order_like_numpy(self, monkeypatch):
+        # sub-unit float differences must not be truncated away natively
+        rows = np.zeros(3, dtype=np.int64)
+        cols = np.array([0, 1, 2], dtype=np.int64)
+        vals = np.ones(3, dtype=np.float32)
+        times = np.array([0.9, 0.1, 0.5])
+        got = pack_padded_csr(rows, cols, vals, 1, 4, max_len=2, times=times,
+                              len_multiple=2)
+        monkeypatch.setenv("PIO_NATIVE", "0")
+        want = pack_padded_csr(rows, cols, vals, 1, 4, max_len=2, times=times,
+                               len_multiple=2)
+        np.testing.assert_array_equal(got.indices, want.indices)
+        # the two newest (0.5, 0.9) survive, in ascending time order
+        real = got.indices[0][got.mask[0] > 0]
+        np.testing.assert_array_equal(real, [2, 0])
+
+    def test_out_of_range_cols_fall_back_consistently(self, monkeypatch):
+        # an out-of-range column id must not be silently remapped natively;
+        # both paths should produce identical (raw) indices
+        rows = np.array([0, 0], dtype=np.int64)
+        cols = np.array([1, 7], dtype=np.int64)  # 7 >= num_cols=4
+        vals = np.ones(2, dtype=np.float32)
+        got = pack_padded_csr(rows, cols, vals, 1, 4)
+        monkeypatch.setenv("PIO_NATIVE", "0")
+        want = pack_padded_csr(rows, cols, vals, 1, 4)
+        np.testing.assert_array_equal(got.indices, want.indices)
+
+    def test_truncation_keeps_most_recent(self):
+        # one row, 6 entries, reversed timestamps, cap 2 -> keeps the 2 newest
+        rows = np.zeros(6, dtype=np.int64)
+        cols = np.arange(6, dtype=np.int64)
+        vals = np.arange(6, dtype=np.float32)
+        times = np.array([5, 4, 3, 2, 1, 0], dtype=np.int64)
+        packed = pack_padded_csr(rows, cols, vals, 1, 6, max_len=2, times=times,
+                                 len_multiple=2)
+        real = packed.indices[0][packed.mask[0] > 0]
+        # newest two are times 4,5 = cols 1,0 in ascending time order
+        np.testing.assert_array_equal(real, [1, 0])
+        assert packed.truncated == 4
+
+    def test_empty_rows_padded(self):
+        rows = np.array([2], dtype=np.int64)
+        cols = np.array([1], dtype=np.int64)
+        vals = np.array([1.0], dtype=np.float32)
+        packed = pack_padded_csr(rows, cols, vals, 5, 3)
+        assert packed.mask[0].sum() == 0
+        assert packed.mask[2].sum() == 1
+        # padding indices all point at the zero-pad column
+        assert (packed.indices[packed.mask == 0] == 3).all()
+
+
+class TestFallback:
+    def test_env_disable_uses_numpy(self, numpy_only):
+        assert native.load() is None
+        rows, cols, vals, _ = _random_coo(100, 8, 8, False, seed=1)
+        packed = pack_padded_csr(rows, cols, vals, 8, 8)
+        assert packed.mask.sum() == 100
