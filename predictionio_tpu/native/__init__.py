@@ -120,10 +120,19 @@ def pack_padded_csr_native(
     lib = load()
     if lib is None:
         return None
+    if cols.size != rows.size or vals.size != rows.size:
+        return None  # numpy fallback raises the proper shape error
     times_arg = None
     if times is not None:
+        times = np.asarray(times)
+        if times.size != rows.size:
+            return None
         # float64 preserves float-timestamp ordering exactly as the numpy
-        # lexsort path sees it (int64 would truncate sub-unit differences)
+        # lexsort path sees it; integer epochs beyond 2^53 would collapse
+        # adjacent values, so those fall back to the exact int64 lexsort
+        if np.issubdtype(times.dtype, np.integer) and times.size:
+            if np.abs(times.astype(np.float64)).max() >= 2.0**53:
+                return None
         times = np.ascontiguousarray(times, dtype=np.float64)
         times_arg = times.ctypes.data_as(ctypes.c_void_p)
     truncated = lib.pack_padded_csr(

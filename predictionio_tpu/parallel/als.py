@@ -118,23 +118,37 @@ def _append_zero_row(factors: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def make_half_step(mesh, config: ALSConfig, implicit: bool):
-    """Build the jitted, sharded half-step: CSR rows sharded over 'data',
-    opposite factors replicated (XLA inserts the all-gather)."""
+def make_iteration(mesh, config: ALSConfig):
+    """Build the jitted full ALS iteration (both half-steps fused).
+
+    CSR rows shard over the 'data' mesh axis; factor matrices live row-
+    sharded and are re-materialized replicated (+ zero pad row) INSIDE the
+    jit, so the all-gather that replaces MLlib's factor-block shuffle is an
+    on-device XLA collective, not a host round-trip. Factor buffers are
+    donated: each iteration updates in place instead of reallocating.
+    """
     row = NamedSharding(mesh, PartitionSpec("data"))
     rep = NamedSharding(mesh, PartitionSpec())
 
-    if implicit:
-        fn = functools.partial(
+    if config.implicit:
+        step = functools.partial(
             _half_step_implicit, reg=config.reg, alpha=config.alpha, rank=config.rank
         )
     else:
-        fn = functools.partial(_half_step_explicit, reg=config.reg, rank=config.rank)
+        step = functools.partial(_half_step_explicit, reg=config.reg, rank=config.rank)
+
+    def iteration(u_idx, u_val, u_msk, i_idx, i_val, i_msk, users, items):
+        items_full = jax.lax.with_sharding_constraint(_append_zero_row(items), rep)
+        users = step(u_idx, u_val, u_msk, items_full)
+        users_full = jax.lax.with_sharding_constraint(_append_zero_row(users), rep)
+        items = step(i_idx, i_val, i_msk, users_full)
+        return users, items
 
     return jax.jit(
-        fn,
-        in_shardings=(row, row, row, rep),
-        out_shardings=row,
+        iteration,
+        in_shardings=(row, row, row, row, row, row, row, row),
+        out_shardings=(row, row),
+        donate_argnums=(6, 7),
     )
 
 
@@ -165,7 +179,10 @@ def als_fit(
     """Run ALS to convergence budget; returns host-side factor matrices.
 
     ``callback(iteration, user_factors, item_factors)`` runs per iteration
-    (checkpointing hook). ``mesh`` defaults to a 1-device local mesh.
+    (checkpointing hook). Factor buffers are donated between iterations, so
+    a callback must copy (``np.asarray``) anything it wants to keep -- the
+    device arrays it receives are consumed by the next iteration.
+    ``mesh`` defaults to a 1-device local mesh.
     """
     from predictionio_tpu.parallel.mesh import local_mesh
 
@@ -189,7 +206,6 @@ def als_fit(
     )
 
     row = NamedSharding(mesh, PartitionSpec("data"))
-    rep = NamedSharding(mesh, PartitionSpec())
     put_row = lambda a: jax.device_put(a, row)
     u_idx = put_row(data.by_row.indices)
     u_val = put_row(data.by_row.values)
@@ -201,14 +217,12 @@ def als_fit(
     user_factors = jax.device_put(users0.astype(dtype), row)
     item_factors = jax.device_put(items0.astype(dtype), row)
 
-    half_step = make_half_step(mesh, config, config.implicit)
+    iteration = make_iteration(mesh, config)
 
     for it in range(config.iterations):
-        # users given items: gather needs items replicated + zero pad row
-        items_full = jax.device_put(_append_zero_row(item_factors), rep)
-        user_factors = half_step(u_idx, u_val, u_msk, items_full)
-        users_full = jax.device_put(_append_zero_row(user_factors), rep)
-        item_factors = half_step(i_idx, i_val, i_msk, users_full)
+        user_factors, item_factors = iteration(
+            u_idx, u_val, u_msk, i_idx, i_val, i_msk, user_factors, item_factors
+        )
         if callback is not None:
             callback(it, user_factors, item_factors)
 

@@ -16,10 +16,6 @@ def _random_coo(n, num_rows, num_cols, with_times, seed):
     return rows, cols, vals, times
 
 
-def _pack_numpy(monkeypatch_env, *args, **kwargs):
-    return pack_padded_csr(*args, **kwargs)
-
-
 @pytest.fixture()
 def numpy_only(monkeypatch):
     monkeypatch.setenv("PIO_NATIVE", "0")
