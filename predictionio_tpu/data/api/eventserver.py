@@ -349,11 +349,24 @@ def create_event_server(
     return ServiceThread(server)
 
 
-def run_event_server(host: str = "0.0.0.0", port: int = DEFAULT_PORT, stats: bool = False) -> None:
+def run_event_server(
+    host: str = "0.0.0.0",
+    port: int = DEFAULT_PORT,
+    stats: bool = False,
+    ssl_cert: str | None = None,
+    ssl_key: str | None = None,
+) -> None:
     """Blocking entry point used by ``pio eventserver``."""
     service = EventService(stats=stats)
-    server = make_server(service.router, host, port, "pio-eventserver")
-    print(f"Event Server listening on http://{host}:{port} (stats={'on' if stats else 'off'})")
+    server = make_server(
+        service.router, host, port, "pio-eventserver",
+        ssl_cert=ssl_cert, ssl_key=ssl_key,
+    )
+    scheme = "https" if ssl_cert else "http"
+    print(
+        f"Event Server listening on {scheme}://{host}:{port}"
+        f" (stats={'on' if stats else 'off'})"
+    )
     try:
         server.serve_forever()
     except KeyboardInterrupt:

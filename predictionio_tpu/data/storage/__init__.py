@@ -43,6 +43,7 @@ _BACKENDS: dict[str, str] = {
     "postgres": "predictionio_tpu.data.storage.postgres",
     # reference TYPE name for the scalikejdbc module; postgres URL required
     "jdbc": "predictionio_tpu.data.storage.postgres",
+    "s3": "predictionio_tpu.data.storage.s3",
 }
 
 _REPOS = ("METADATA", "EVENTDATA", "MODELDATA")
@@ -57,8 +58,13 @@ class StorageError(RuntimeError):
     pass
 
 
-def _base_dir() -> str:
+def base_dir() -> str:
+    """The filesystem root (``$PIO_FS_BASEDIR``) shared by storage defaults,
+    daemon pidfiles/logs, and the native-kernel cache fallback."""
     return os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+
+
+_base_dir = base_dir
 
 
 class _Registry:

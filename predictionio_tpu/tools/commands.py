@@ -13,6 +13,7 @@ def register(sub: argparse._SubParsersAction) -> None:
     from predictionio_tpu.tools import (
         app_commands,
         build_commands,
+        daemon_commands,
         engine_commands,
         import_export,
         server_commands,
@@ -20,6 +21,7 @@ def register(sub: argparse._SubParsersAction) -> None:
 
     app_commands.register(sub)
     build_commands.register(sub)
+    daemon_commands.register(sub)
     engine_commands.register(sub)
     import_export.register(sub)
     server_commands.register(sub)

@@ -33,12 +33,20 @@ def register(sub: argparse._SubParsersAction) -> None:
     deploy.add_argument("--feedback", action="store_true")
     deploy.add_argument("--event-server-ip", default="localhost")
     deploy.add_argument("--event-server-port", type=int, default=7070)
+    deploy.add_argument("--event-server-scheme", default="http",
+                        choices=("http", "https"),
+                        help="https when the event server uses --ssl-cert")
     deploy.add_argument("--accesskey", default="")
+    # python analogue of the reference's --key-store TLS option
+    deploy.add_argument("--ssl-cert", default=None, help="PEM cert: serve HTTPS")
+    deploy.add_argument("--ssl-key", default=None, help="PEM key (if not in cert)")
     deploy.set_defaults(func=cmd_deploy)
 
     undeploy = sub.add_parser("undeploy", help="stop a deployed engine server")
     undeploy.add_argument("--ip", default="localhost")
     undeploy.add_argument("--port", type=int, default=8000)
+    undeploy.add_argument("--ssl", action="store_true",
+                          help="server was deployed with --ssl-cert")
     undeploy.set_defaults(func=cmd_undeploy)
 
     ev = sub.add_parser("eval", help="run an evaluation")
@@ -92,7 +100,10 @@ def cmd_deploy(args: argparse.Namespace) -> int:
     feedback = None
     if args.feedback:
         feedback = FeedbackConfig(
-            event_server_url=f"http://{args.event_server_ip}:{args.event_server_port}",
+            event_server_url=(
+                f"{args.event_server_scheme}://"
+                f"{args.event_server_ip}:{args.event_server_port}"
+            ),
             access_key=args.accesskey,
         )
     run_query_server(
@@ -101,23 +112,39 @@ def cmd_deploy(args: argparse.Namespace) -> int:
         port=args.port,
         instance_id=args.engine_instance_id,
         feedback=feedback,
+        ssl_cert=args.ssl_cert,
+        ssl_key=args.ssl_key,
     )
     return 0
 
 
 def cmd_undeploy(args: argparse.Namespace) -> int:
+    import ssl
     import urllib.request
 
-    url = f"http://{args.ip}:{args.port}/stop"
-    try:
-        urllib.request.urlopen(
-            urllib.request.Request(url, method="POST", data=b""), timeout=5
-        )
-        print("Engine server stopping.")
-        return 0
-    except Exception as exc:
-        print(f"Error: cannot reach engine server at {url}: {exc}")
-        return 1
+    # try the flagged scheme first, then the other (a TLS-deployed server
+    # must be stoppable even when --ssl was forgotten, and vice versa)
+    schemes = ("https", "http") if args.ssl else ("http", "https")
+    insecure = ssl.create_default_context()
+    insecure.check_hostname = False
+    insecure.verify_mode = ssl.CERT_NONE
+    last_exc = None
+    for scheme in schemes:
+        url = f"{scheme}://{args.ip}:{args.port}/stop"
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(url, method="POST", data=b""),
+                timeout=5,
+                context=insecure if scheme == "https" else None,
+            )
+            print("Engine server stopping.")
+            return 0
+        except Exception as exc:
+            last_exc = exc
+    print(
+        f"Error: cannot reach engine server at {args.ip}:{args.port}: {last_exc}"
+    )
+    return 1
 
 
 def _resolve_dotted(dotted: str, engine_dir: str):

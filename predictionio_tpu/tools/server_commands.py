@@ -10,6 +10,8 @@ def register(sub: argparse._SubParsersAction) -> None:
     es.add_argument("--ip", default="0.0.0.0")
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true", help="enable /stats.json")
+    es.add_argument("--ssl-cert", default=None, help="PEM cert: serve HTTPS")
+    es.add_argument("--ssl-key", default=None, help="PEM key (if not in cert)")
     es.set_defaults(func=cmd_eventserver)
 
     db = sub.add_parser("dashboard", help="start the evaluation dashboard")
@@ -29,7 +31,10 @@ def register(sub: argparse._SubParsersAction) -> None:
 def cmd_eventserver(args: argparse.Namespace) -> int:
     from predictionio_tpu.data.api.eventserver import run_event_server
 
-    run_event_server(host=args.ip, port=args.port, stats=args.stats)
+    run_event_server(
+        host=args.ip, port=args.port, stats=args.stats,
+        ssl_cert=args.ssl_cert, ssl_key=args.ssl_key,
+    )
     return 0
 
 

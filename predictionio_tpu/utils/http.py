@@ -97,7 +97,17 @@ _CORS_HEADERS = {
 }
 
 
-def make_server(router: Router, host: str, port: int, server_name: str) -> ThreadingHTTPServer:
+def make_server(
+    router: Router,
+    host: str,
+    port: int,
+    server_name: str,
+    ssl_cert: str | None = None,
+    ssl_key: str | None = None,
+) -> ThreadingHTTPServer:
+    """Build the threaded server; with ``ssl_cert``/``ssl_key`` it serves
+    HTTPS (parity role of the reference query server's ``--key-store`` TLS,
+    SURVEY.md section 2.3 #25)."""
     class _RequestHandler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         server_version = server_name
@@ -139,7 +149,21 @@ def make_server(router: Router, host: str, port: int, server_name: str) -> Threa
 
         do_GET = do_POST = do_DELETE = do_PUT = do_OPTIONS = _handle
 
-    return ThreadingHTTPServer((host, port), _RequestHandler)
+    if ssl_key and not ssl_cert:
+        raise ValueError("ssl_key given without ssl_cert; TLS not enabled")
+    server = ThreadingHTTPServer((host, port), _RequestHandler)
+    if ssl_cert:
+        import ssl
+
+        context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        context.load_cert_chain(certfile=ssl_cert, keyfile=ssl_key or None)
+        # handshake on first read, NOT in accept(): with on-connect handshake
+        # a stalled client would block the single accept loop and freeze the
+        # whole server; deferred, it runs in the per-connection thread
+        server.socket = context.wrap_socket(
+            server.socket, server_side=True, do_handshake_on_connect=False
+        )
+    return server
 
 
 class ServiceThread:

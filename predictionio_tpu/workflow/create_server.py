@@ -234,10 +234,15 @@ def create_query_server(
     variant: EngineVariant,
     host: str = "0.0.0.0",
     port: int = DEFAULT_PORT,
+    ssl_cert: str | None = None,
+    ssl_key: str | None = None,
     **service_kwargs,
 ) -> tuple[ServiceThread, QueryService]:
     service = QueryService(variant, **service_kwargs)
-    server = make_server(service.router, host, port, "pio-queryserver")
+    server = make_server(
+        service.router, host, port, "pio-queryserver",
+        ssl_cert=ssl_cert, ssl_key=ssl_key,
+    )
     return ServiceThread(server), service
 
 
@@ -246,9 +251,10 @@ def run_query_server(
 ) -> None:
     """Blocking entry point used by ``pio deploy``."""
     thread, service = create_query_server(variant, host, port, **kw)
+    scheme = "https" if kw.get("ssl_cert") else "http"
     thread.start()
     print(
-        f"Query Server listening on http://{host}:{port}"
+        f"Query Server listening on {scheme}://{host}:{port}"
         f" (engine instance {service.instance.id})"
     )
     try:

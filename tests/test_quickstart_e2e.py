@@ -1,0 +1,147 @@
+"""Dockerless quickstart integration test: real subprocesses, real HTTP.
+
+Parity role of the reference's ``tests/pio_tests/scenarios/quickstart_test
+.py`` harness (SURVEY.md section 4 tier 3): drive the actual CLI end to end
+-- app new -> REST event ingestion -> train -> deploy -> query -> undeploy
+-- against a scratch storage root, asserting on the wire responses.
+"""
+
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(url: str, timeout: float = 30.0) -> None:
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                if resp.status < 500:
+                    return
+        except Exception as exc:
+            last = exc
+        time.sleep(0.4)
+    raise TimeoutError(f"{url} never came up: {last}")
+
+
+def _post(url: str, payload) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.load(resp)
+
+
+@pytest.fixture()
+def quickstart_env(tmp_path):
+    env = dict(os.environ)
+    env.update(
+        PIO_FS_BASEDIR=str(tmp_path),
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        JAX_PLATFORMS="cpu",
+    )
+    procs = []
+    yield env, procs
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _cli(env, *argv, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.tools.cli", *argv],
+        env=env, capture_output=True, text=True, timeout=300, **kw,
+    )
+
+
+def test_quickstart(quickstart_env, tmp_path):
+    env, procs = quickstart_env
+
+    # pio template get + app new
+    engine_dir = tmp_path / "engine"
+    r = _cli(env, "template", "get", "recommendation", str(engine_dir),
+             "--app-name", "QuickstartApp")
+    assert r.returncode == 0, r.stderr
+    r = _cli(env, "app", "new", "QuickstartApp")
+    assert r.returncode == 0, r.stderr
+    access_key = [ln for ln in r.stdout.splitlines() if "Access Key" in ln][0].split()[-1]
+
+    # event server + REST ingestion (single + batch)
+    es_port = _free_port()
+    es = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.tools.cli", "eventserver",
+         "--ip", "127.0.0.1", "--port", str(es_port), "--stats"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    procs.append(es)
+    base = f"http://127.0.0.1:{es_port}"
+    _wait_http(f"{base}/stats.json")
+
+    rng = random.Random(0)
+    single = _post(
+        f"{base}/events.json?accessKey={access_key}",
+        {"event": "rate", "entityType": "user", "entityId": "u0",
+         "targetEntityType": "item", "targetEntityId": "i0",
+         "properties": {"rating": 5}},
+    )
+    assert "eventId" in single
+    batch = [
+        {"event": "rate", "entityType": "user",
+         "entityId": f"u{rng.randrange(15)}",
+         "targetEntityType": "item", "targetEntityId": f"i{rng.randrange(20)}",
+         "properties": {"rating": rng.randint(1, 5)}}
+        for _ in range(120)
+    ]
+    for i in range(0, len(batch), 50):
+        statuses = _post(
+            f"{base}/batch/events.json?accessKey={access_key}", batch[i:i + 50]
+        )
+        assert all(s["status"] == 201 for s in statuses)
+
+    # train
+    r = _cli(env, "train", "--engine-dir", str(engine_dir))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Training completed" in r.stdout
+
+    # deploy + query
+    qs_port = _free_port()
+    qs = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.tools.cli", "deploy",
+         "--engine-dir", str(engine_dir), "--ip", "127.0.0.1",
+         "--port", str(qs_port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    procs.append(qs)
+    qbase = f"http://127.0.0.1:{qs_port}"
+    _wait_http(f"{qbase}/", timeout=90)
+
+    result = _post(f"{qbase}/queries.json", {"user": "u0", "num": 4})
+    assert len(result["itemScores"]) == 4
+    scores = [x["score"] for x in result["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+
+    # undeploy stops the server
+    r = _cli(env, "undeploy", "--ip", "127.0.0.1", "--port", str(qs_port))
+    assert r.returncode == 0, r.stdout
+    qs.wait(timeout=30)
+    assert qs.returncode is not None

@@ -1,0 +1,118 @@
+"""Server ops tests: TLS serving and start-all/stop-all daemon management."""
+
+import os
+import ssl
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.tools.cli import main
+
+
+def _self_signed_cert(tmp_path):
+    """Generate a throwaway self-signed cert with the openssl CLI."""
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    proc = subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(cert), "-days", "1",
+            "-subj", "/CN=localhost",
+        ],
+        capture_output=True,
+    )
+    if proc.returncode != 0:
+        pytest.skip("openssl unavailable")
+    return str(cert), str(key)
+
+
+class TestTLS:
+    def test_event_server_serves_https(self, storage_env, tmp_path):
+        from predictionio_tpu.data.api.eventserver import EventService
+        from predictionio_tpu.utils.http import ServiceThread, make_server
+
+        cert, key = _self_signed_cert(tmp_path)
+        service = EventService(stats=True)
+        server = make_server(
+            service.router, "127.0.0.1", 0, "pio-eventserver",
+            ssl_cert=cert, ssl_key=key,
+        )
+        svc = ServiceThread(server).start()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{svc.port}/stats.json", context=ctx, timeout=5
+            ) as resp:
+                assert resp.status == 200
+        finally:
+            svc.stop()
+
+
+class TestDaemons:
+    def test_start_all_stop_all(self, storage_env, tmp_path, capsys, monkeypatch):
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        # high ports to avoid collisions with anything else on the box
+        code = main([
+            "start-all", "--event-server-port", "27070",
+            "--dashboard-port", "29000", "--admin-port", "27071",
+        ])
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert out.count("started") == 3
+
+        # pidfiles exist and the event server actually answers
+        for svc in ("eventserver", "dashboard", "adminserver"):
+            assert (tmp_path / "pids" / f"{svc}.pid").exists()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:29000/", timeout=2
+                ) as resp:
+                    assert resp.status == 200
+                break
+            except Exception:
+                time.sleep(0.5)
+        else:
+            pytest.fail("dashboard daemon never came up")
+
+        # idempotent start: running services are not respawned
+        code = main([
+            "start-all", "--event-server-port", "27070",
+            "--dashboard-port", "29000", "--admin-port", "27071",
+        ])
+        out = capsys.readouterr().out
+        assert out.count("already running") == 3
+
+        code = main(["stop-all"])
+        out = capsys.readouterr().out
+        assert code == 0
+        assert out.count("stopped") == 3
+        for svc in ("eventserver", "dashboard", "adminserver"):
+            assert not (tmp_path / "pids" / f"{svc}.pid").exists()
+
+    def test_stop_all_handles_stale_pidfiles(self, storage_env, tmp_path, capsys, monkeypatch):
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        os.makedirs(tmp_path / "pids")
+        (tmp_path / "pids" / "eventserver.pid").write_text("999999999")
+        code = main(["stop-all"])
+        out = capsys.readouterr().out
+        assert code == 0
+        assert "stale pidfile" in out
+        assert not (tmp_path / "pids" / "eventserver.pid").exists()
+
+    def test_stop_all_never_kills_a_recycled_pid(self, storage_env, tmp_path, capsys, monkeypatch):
+        """A pidfile pointing at a live process that is NOT a pio daemon
+        (pid recycled after reboot) must be treated as stale, not killed."""
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        os.makedirs(tmp_path / "pids")
+        # this very pytest process: alive, but not the pio CLI
+        (tmp_path / "pids" / "eventserver.pid").write_text(str(os.getpid()))
+        code = main(["stop-all"])
+        out = capsys.readouterr().out
+        assert code == 0
+        assert "stale pidfile" in out  # and we are still alive to assert it
