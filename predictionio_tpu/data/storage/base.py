@@ -98,6 +98,22 @@ class Model:
     models: bytes
 
 
+def safe_blob_name(model_id: str) -> str:
+    """Collision-free file/object name for a model id (shared by the
+    localfs and s3 blob stores).
+
+    Reversible encoding: ids starting with "x" always take the encoded
+    branch, so a literal id can never collide with another id's hex
+    encoding."""
+    if not model_id.startswith("x") and all(
+        c.isalnum() or c in "-_" for c in model_id
+    ):
+        safe = model_id
+    else:
+        safe = "x" + model_id.encode("utf-8").hex()
+    return f"pio_model_{safe}.bin"
+
+
 @dataclass
 class StorageClientConfig:
     parallel: bool = False

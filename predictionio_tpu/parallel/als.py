@@ -119,6 +119,14 @@ def _append_zero_row(factors: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_iteration(mesh, config: ALSConfig):
+    """The jitted full ALS iteration for (mesh, config) -- see _build_iteration."""
+    return _build_iteration(
+        mesh, config.rank, config.reg, config.alpha, config.implicit
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _build_iteration(mesh, rank: int, reg: float, alpha: float, implicit: bool):
     """Build the jitted full ALS iteration (both half-steps fused).
 
     CSR rows shard over the 'data' mesh axis; factor matrices live row-
@@ -126,16 +134,19 @@ def make_iteration(mesh, config: ALSConfig):
     jit, so the all-gather that replaces MLlib's factor-block shuffle is an
     on-device XLA collective, not a host round-trip. Factor buffers are
     donated: each iteration updates in place instead of reallocating.
+
+    Cached by hyperparameters so repeated ``als_fit`` calls in one process
+    (serving retrains, benchmarks, grid evaluations) reuse the compilation.
     """
     row = NamedSharding(mesh, PartitionSpec("data"))
     rep = NamedSharding(mesh, PartitionSpec())
 
-    if config.implicit:
+    if implicit:
         step = functools.partial(
-            _half_step_implicit, reg=config.reg, alpha=config.alpha, rank=config.rank
+            _half_step_implicit, reg=reg, alpha=alpha, rank=rank
         )
     else:
-        step = functools.partial(_half_step_explicit, reg=config.reg, rank=config.rank)
+        step = functools.partial(_half_step_explicit, reg=reg, rank=rank)
 
     def iteration(u_idx, u_val, u_msk, i_idx, i_val, i_msk, users, items):
         items_full = jax.lax.with_sharding_constraint(_append_zero_row(items), rep)

@@ -58,8 +58,10 @@ def _alive(pid: int) -> bool:
     never be signalled."""
     try:
         os.kill(pid, 0)
-    except (ProcessLookupError, PermissionError):
+    except ProcessLookupError:
         return False
+    except PermissionError:
+        pass  # EPERM = the process EXISTS, just owned by someone else
     try:
         with open(f"/proc/{pid}/cmdline", "rb") as f:
             cmdline = f.read().decode("utf-8", "replace")
@@ -126,19 +128,24 @@ def cmd_stop_all(args: argparse.Namespace) -> int:
         pidfile = _pid_path(service)
         if pid is None:
             continue
+        drop_pidfile = True
         if _alive(pid):
             try:
                 os.kill(pid, signal.SIGTERM)
                 print(f"{service}: stopped (pid {pid})")
                 stopped += 1
             except OSError as exc:
+                # daemon still running: keep the pidfile so a later
+                # (privileged) stop-all can still find it
                 print(f"{service}: could not stop pid {pid}: {exc}")
+                drop_pidfile = False
         else:
             print(f"{service}: not running (stale pidfile)")
-        try:
-            os.unlink(pidfile)
-        except OSError:
-            pass
+        if drop_pidfile:
+            try:
+                os.unlink(pidfile)
+            except OSError:
+                pass
     if stopped == 0:
         print("Nothing to stop.")
     return 0

@@ -122,14 +122,17 @@ def cmd_undeploy(args: argparse.Namespace) -> int:
     import ssl
     import urllib.request
 
-    # try the flagged scheme first, then the other (a TLS-deployed server
-    # must be stoppable even when --ssl was forgotten, and vice versa)
+    import http.client
+
+    # try the flagged scheme first; fall back to the other scheme ONLY on
+    # errors that look like a scheme mismatch (TLS handshake noise / bad
+    # status line), so a plainly-down server reports its real error once
     schemes = ("https", "http") if args.ssl else ("http", "https")
     insecure = ssl.create_default_context()
     insecure.check_hostname = False
     insecure.verify_mode = ssl.CERT_NONE
-    last_exc = None
-    for scheme in schemes:
+    first_exc = None
+    for attempt, scheme in enumerate(schemes):
         url = f"{scheme}://{args.ip}:{args.port}/stop"
         try:
             urllib.request.urlopen(
@@ -140,9 +143,16 @@ def cmd_undeploy(args: argparse.Namespace) -> int:
             print("Engine server stopping.")
             return 0
         except Exception as exc:
-            last_exc = exc
+            if attempt == 0:
+                first_exc = exc
+                root = getattr(exc, "reason", exc)
+                mismatch = isinstance(
+                    root, (ssl.SSLError, http.client.BadStatusLine)
+                )
+                if not mismatch:
+                    break
     print(
-        f"Error: cannot reach engine server at {args.ip}:{args.port}: {last_exc}"
+        f"Error: cannot reach engine server at {args.ip}:{args.port}: {first_exc}"
     )
     return 1
 

@@ -3,7 +3,7 @@
 import numpy as np
 import pytest
 
-from predictionio_tpu.ops.linalg import batched_spd_solve
+from predictionio_tpu.ops.linalg import _unrolled_chol_solve, batched_spd_solve
 from predictionio_tpu.ops.ragged import pack_padded_csr
 
 
@@ -59,3 +59,31 @@ class TestBatchedSolve:
         rhs = np.zeros((2, 4), dtype=np.float32)
         x = np.asarray(batched_spd_solve(gram, rhs))
         assert np.isfinite(x).all()
+
+    def test_unrolled_matches_lax_path(self):
+        # the unrolled batch-major path must agree with lax cholesky+cho_solve
+        # (which batched_spd_solve falls back to above _UNROLL_MAX_K)
+        import jax.numpy as jnp
+        from jax.lax.linalg import cholesky
+        from jax.scipy.linalg import cho_solve
+
+        rng = np.random.default_rng(1)
+        for k in (3, 8, 16):
+            a = rng.normal(size=(64, k, k)).astype(np.float32)
+            gram = np.einsum("bij,bkj->bik", a, a) + 2.0 * np.eye(k, dtype=np.float32)
+            rhs = rng.normal(size=(64, k)).astype(np.float32)
+            ours = np.asarray(_unrolled_chol_solve(jnp.asarray(gram), jnp.asarray(rhs)))
+            ref = np.asarray(
+                cho_solve((cholesky(jnp.asarray(gram)), True), jnp.asarray(rhs)[..., None])
+            )[..., 0]
+            np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+    def test_large_rank_falls_back(self):
+        rng = np.random.default_rng(2)
+        k = 40  # > _UNROLL_MAX_K
+        a = rng.normal(size=(4, k, k)).astype(np.float32)
+        gram = np.einsum("bij,bkj->bik", a, a) + 2.0 * np.eye(k, dtype=np.float32)
+        x_true = rng.normal(size=(4, k)).astype(np.float32)
+        rhs = np.einsum("bij,bj->bi", gram, x_true)
+        x = np.asarray(batched_spd_solve(gram, rhs))
+        assert np.abs(x - x_true).max() < 5e-2

@@ -55,41 +55,44 @@ class TestTLS:
 class TestDaemons:
     def test_start_all_stop_all(self, storage_env, tmp_path, capsys, monkeypatch):
         monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
-        # high ports to avoid collisions with anything else on the box
-        code = main([
-            "start-all", "--event-server-port", "27070",
-            "--dashboard-port", "29000", "--admin-port", "27071",
-        ])
-        out = capsys.readouterr().out
-        assert code == 0, out
-        assert out.count("started") == 3
+        try:
+            # high ports to avoid collisions with anything else on the box
+            code = main([
+                "start-all", "--event-server-port", "27070",
+                "--dashboard-port", "29000", "--admin-port", "27071",
+            ])
+            out = capsys.readouterr().out
+            assert code == 0, out
+            assert out.count("started") == 3
 
-        # pidfiles exist and the event server actually answers
-        for svc in ("eventserver", "dashboard", "adminserver"):
-            assert (tmp_path / "pids" / f"{svc}.pid").exists()
-        deadline = time.time() + 15
-        while time.time() < deadline:
-            try:
-                with urllib.request.urlopen(
-                    "http://127.0.0.1:29000/", timeout=2
-                ) as resp:
-                    assert resp.status == 200
-                break
-            except Exception:
-                time.sleep(0.5)
-        else:
-            pytest.fail("dashboard daemon never came up")
+            # pidfiles exist and the event server actually answers
+            for svc in ("eventserver", "dashboard", "adminserver"):
+                assert (tmp_path / "pids" / f"{svc}.pid").exists()
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        "http://127.0.0.1:29000/", timeout=2
+                    ) as resp:
+                        assert resp.status == 200
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            else:
+                pytest.fail("dashboard daemon never came up")
 
-        # idempotent start: running services are not respawned
-        code = main([
-            "start-all", "--event-server-port", "27070",
-            "--dashboard-port", "29000", "--admin-port", "27071",
-        ])
-        out = capsys.readouterr().out
-        assert out.count("already running") == 3
-
-        code = main(["stop-all"])
-        out = capsys.readouterr().out
+            # idempotent start: running services are not respawned
+            code = main([
+                "start-all", "--event-server-port", "27070",
+                "--dashboard-port", "29000", "--admin-port", "27071",
+            ])
+            out = capsys.readouterr().out
+            assert out.count("already running") == 3
+        finally:
+            # daemons must die even when an assertion above fails, or they
+            # squat the fixed ports for every later run on this box
+            code = main(["stop-all"])
+            out = capsys.readouterr().out
         assert code == 0
         assert out.count("stopped") == 3
         for svc in ("eventserver", "dashboard", "adminserver"):
