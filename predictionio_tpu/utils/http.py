@@ -111,6 +111,13 @@ def make_server(
     class _RequestHandler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         server_version = server_name
+        # one TCP segment per response: buffered wfile (handle_one_request
+        # flushes it) + NODELAY. Without these, headers and body go out as
+        # separate small segments and Nagle + client delayed-ACK adds ~40ms
+        # to EVERY keep-alive request -- the difference between a 1ms and a
+        # 44ms p50 on /queries.json
+        wbufsize = -1
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # quiet by default; services log themselves
             pass
