@@ -192,6 +192,7 @@ class SASRecAlgorithm(TPUAlgorithm):
             epochs=p.get_or("epochs", 10),
             seed=p.get_or("seed", 0),
             seq_parallel=p.get_or("seqParallel", "ring"),
+            attention=p.get_or("attention", "auto"),
         )
         params, _ = train_sasrec(config, prepared.matrix, ctx.mesh)
         histories = {

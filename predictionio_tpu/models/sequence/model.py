@@ -27,6 +27,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.parallel.mesh import check_steps_ran
+from predictionio_tpu.ops.flash_attention import flash_attention
 from predictionio_tpu.parallel.ring_attention import plain_attention, ring_attention
 from predictionio_tpu.parallel.ulysses import ulysses_attention
 
@@ -45,12 +46,20 @@ class SASRecConfig:
     epochs: int = 10
     seed: int = 0
     seq_parallel: str = "ring"  # "ring" | "ulysses" (all-to-all head scatter)
+    #: intra-shard attention: "auto" = Pallas flash attention on TPU, the
+    #: materialized-score reference elsewhere; "flash" / "plain" force it
+    attention: str = "auto"
 
     def __post_init__(self):
         if self.embed_dim % self.num_heads:
             raise ValueError(
                 f"embed_dim={self.embed_dim} must be divisible by "
                 f"num_heads={self.num_heads}"
+            )
+        if self.attention not in ("auto", "flash", "plain"):
+            raise ValueError(
+                f"attention={self.attention!r} must be one of"
+                " 'auto' | 'flash' | 'plain'"
             )
         if self.seq_parallel not in ("ring", "ulysses"):
             raise ValueError(
@@ -80,10 +89,29 @@ class _MultiHeadSelfAttention(nn.Module):
         reshape = lambda a: a.reshape(b, t, h, head_dim)
         q, k, v = reshape(q), reshape(k), reshape(v)
         mesh = self.mesh
+        backend = jax.default_backend()
+        use_flash = c.attention == "flash" or (
+            c.attention == "auto" and backend == "tpu"
+        )
         if mesh is not None and mesh.shape.get("seq", 1) > 1:
-            sp_attn = ulysses_attention if c.seq_parallel == "ulysses" else ring_attention
-            out = sp_attn(q, k, v, mesh, axis_name="seq", causal=True,
-                          mask=pad_mask)
+            if c.seq_parallel == "ulysses":
+                # ulysses gathers full sequences per chip, so the flash
+                # kernel slots in as its local attention
+                out = ulysses_attention(q, k, v, mesh, axis_name="seq",
+                                        causal=True, mask=pad_mask,
+                                        use_flash=use_flash)
+            else:
+                # ring attention IS the online softmax across shards; its
+                # per-step scores are already [Tl, Tl] blocks, so "flash"
+                # asks for nothing it does not already do
+                out = ring_attention(q, k, v, mesh, axis_name="seq",
+                                     causal=True, mask=pad_mask)
+        elif use_flash:
+            # O(T*D) memory: scores never materialize (ops/flash_attention)
+            out = flash_attention(
+                q, k, v, pad_mask, causal=True,
+                interpret=backend != "tpu",
+            )
         else:
             out = plain_attention(q, k, v, causal=True, mask=pad_mask)
         return nn.Dense(d, use_bias=False, name="proj")(out.reshape(b, t, d))

@@ -1,0 +1,311 @@
+"""Pallas flash attention: tiled online-softmax attention for TPU.
+
+``plain_attention`` (parallel/ring_attention.py) materializes the full
+[B, H, T, T] score matrix -- fine for short histories, O(T^2) HBM for long
+ones. This kernel computes the same attention with scores living only in
+VMEM tiles, carrying the flash-attention running (max, sum, acc) statistics
+across key blocks, plus the matching custom-VJP backward (recomputation
+form: probabilities are rebuilt per tile from the saved logsumexp, never
+stored).
+
+Role in the framework: the intra-shard / single-device attention for the
+sequence template (``models/sequence``). Across mesh shards the same online
+softmax runs at the ring level (``parallel.ring_attention``); within a
+shard, this kernel keeps the memory footprint O(T * D) so per-chip
+sequences can grow until HBM, not VMEM-score-matrix, is the limit.
+
+Shapes follow plain_attention: q, k, v [B, T, H, D]; optional key-validity
+``mask`` [B, T]; causal masking over absolute positions. On CPU test
+backends the kernels run in interpret mode (tests pin fwd+grad against
+plain_attention).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30  # matches plain_attention's finite masked-score constant
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _pos(n: int, offset):
+    # 2D iota (1D iota fails on TPU), squeezed after
+    return offset + jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)[:, 0]
+
+
+def _fwd_kernel(
+    q_ref,      # [1, BQ, 1, D]
+    k_ref,      # [1, T, 1, D]
+    v_ref,      # [1, T, 1, D]
+    mask_ref,   # [1, T]
+    out_ref,    # [1, BQ, 1, D]
+    lse_ref,    # [1, BQ]
+    *, causal: bool, sm_scale: float, block_k: int,
+):
+    qi = pl.program_id(2)
+    bq, d = q_ref.shape[1], q_ref.shape[3]
+    t = k_ref.shape[1]
+    q = q_ref[0, :, 0, :].astype(jnp.float32)
+    q_pos = _pos(bq, qi * bq)
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        msk = mask_ref[0, pl.ds(kb * block_k, block_k)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                                        # [BQ, BK]
+        k_pos = _pos(block_k, kb * block_k)
+        valid = msk[None, :]
+        if causal:
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None]) * valid             # [BQ, BK]
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, t // block_k, body, (acc0, m0, l0))
+
+    out_ref[0, :, 0, :] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(out_ref.dtype)
+    lse_ref[0, :] = m + jnp.log(jnp.maximum(l, 1e-20))
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    *, causal: bool, sm_scale: float, block_k: int,
+):
+    """dQ for one query block: dq = sum_kb (P o (dP - delta)) K * scale."""
+    qi = pl.program_id(2)
+    bq, d = q_ref.shape[1], q_ref.shape[3]
+    t = k_ref.shape[1]
+    q = q_ref[0, :, 0, :].astype(jnp.float32)
+    do = do_ref[0, :, 0, :].astype(jnp.float32)
+    lse = lse_ref[0, :]
+    delta = delta_ref[0, :]
+    q_pos = _pos(bq, qi * bq)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        msk = mask_ref[0, pl.ds(kb * block_k, block_k)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        k_pos = _pos(block_k, kb * block_k)
+        valid = msk[None, :]
+        if causal:
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, t // block_k, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, :, 0, :] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    *, causal: bool, sm_scale: float, block_q: int,
+):
+    """dK/dV for one key block: loop over query blocks."""
+    ki = pl.program_id(2)
+    bk, d = k_ref.shape[1], k_ref.shape[3]
+    t = q_ref.shape[1]
+    k_blk = k_ref[0, :, 0, :].astype(jnp.float32)
+    v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
+    msk = mask_ref[0, pl.ds(ki * bk, bk)]
+    k_pos = _pos(bk, ki * bk)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), 0, :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), 0, :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        q_pos = _pos(block_q, qb * block_q)
+        valid = msk[None, :]
+        if causal:
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)   # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, t // block_q, body, (dk0, dv0))
+    dk_ref[0, :, 0, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, :, 0, :] = dv.astype(dv_ref.dtype)
+
+
+def _pad_t(x, t_padded):
+    pad = t_padded - x.shape[1]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[1] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _specs(b_dim, t, h_dim, d, bq):
+    """(index-mapped) block specs shared by the three kernels."""
+    q_spec = pl.BlockSpec((1, bq, 1, d), lambda b, h, i: (b, i, h, 0))
+    kv_spec = pl.BlockSpec((1, t, 1, d), lambda b, h, i: (b, 0, h, 0))
+    mask_spec = pl.BlockSpec((1, t), lambda b, h, i: (b, 0))
+    row_spec = pl.BlockSpec((1, bq), lambda b, h, i: ((b * h_dim + h), i))
+    return q_spec, kv_spec, mask_spec, row_spec
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q, k, v, mask, causal=True, sm_scale=None, interpret=False):
+    """Flash attention. q,k,v [B, T, H, D] -> [B, T, H, D].
+
+    ``mask``: [B, T] key-validity mask, or None for all-valid. Note rows
+    whose every key is masked come back ~0 (the flash/ring convention),
+    where ``plain_attention`` would return a uniform average -- such rows
+    are padding and must be loss-masked by the caller either way.
+    """
+    out, _ = _flash_fwd(q, k, v, mask, causal, sm_scale, interpret)
+    return out
+
+
+def _flash_forward(q, k, v, mask, causal, sm_scale, interpret):
+    b, t, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else d**-0.5
+    bq = bk = BLOCK_Q
+    t_padded = -(-t // bq) * bq
+    if mask is None:
+        mask = jnp.ones((b, t), bool)
+    qp, kp, vp = (_pad_t(x, t_padded) for x in (q, k, v))
+    maskp = _pad_t(mask.astype(bool), t_padded)  # pad -> False (invalid)
+
+    nq = t_padded // bq
+    q_spec, kv_spec, mask_spec, row_spec = _specs(b, t_padded, h, d, bq)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, causal=causal, sm_scale=scale, block_k=bk
+        ),
+        grid=(b, h, nq),
+        in_specs=[q_spec, kv_spec, kv_spec, mask_spec],
+        out_specs=[q_spec, row_spec],
+        out_shape=[
+            _struct(qp.shape, q.dtype, q),
+            _struct((b * h, t_padded), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, maskp)
+    return out[:, :t], lse
+
+
+def _struct(shape, dtype, like):
+    """ShapeDtypeStruct that inherits `like`'s varying-mesh-axes (vma) so
+    the kernel composes under shard_map(check_vma=True); plain (non-sharded)
+    callers get the ordinary struct."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret):
+    out, lse = _flash_forward(q, k, v, mask, causal, sm_scale, interpret)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, interpret, res, g):
+    q, k, v, mask, out, lse = res
+    b, t, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else d**-0.5
+    bq = bk = BLOCK_Q
+    t_padded = -(-t // bq) * bq
+    if mask is None:
+        mask = jnp.ones((b, t), bool)
+        mask_grad = None
+    else:
+        import numpy as np
+
+        mask_grad = np.zeros(mask.shape, jax.dtypes.float0)
+
+    # delta[b,h,i] = rowsum(dO o O): the softmax-jacobian correction term
+    delta = jnp.einsum("bthd,bthd->bht", g.astype(jnp.float32),
+                       out.astype(jnp.float32)).reshape(b * h, t)
+
+    qp, kp, vp, gp = (_pad_t(x, t_padded) for x in (q, k, v, g))
+    maskp = _pad_t(mask.astype(bool), t_padded)
+    lsep = jnp.pad(lse, ((0, 0), (0, t_padded - t)))
+    deltap = jnp.pad(delta, ((0, 0), (0, t_padded - t)))
+
+    nq = t_padded // bq
+    nk = t_padded // bk
+    q_spec, kv_spec, mask_spec, row_spec = _specs(b, t_padded, h, d, bq)
+    full_row = pl.BlockSpec((1, t_padded), lambda b_, h_, i: ((b_ * h + h_), 0))
+    full_q = pl.BlockSpec((1, t_padded, 1, d), lambda b_, h_, i: (b_, 0, h_, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, sm_scale=scale, block_k=bk),
+        grid=(b, h, nq),
+        in_specs=[q_spec, kv_spec, kv_spec, mask_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=_struct(qp.shape, q.dtype, q),
+        interpret=interpret,
+    )(qp, kp, vp, maskp, gp, lsep, deltap)
+
+    k_spec = pl.BlockSpec((1, bk, 1, d), lambda b_, h_, i: (b_, i, h_, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, sm_scale=scale, block_q=bq),
+        grid=(b, h, nk),
+        in_specs=[full_q, k_spec, k_spec, mask_spec, full_q, full_row, full_row],
+        out_specs=[k_spec, k_spec],
+        out_shape=[
+            _struct(kp.shape, k.dtype, k),
+            _struct(vp.shape, v.dtype, v),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, maskp, gp, lsep, deltap)
+
+    return dq[:, :t], dk[:, :t], dv[:, :t], mask_grad
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -41,19 +41,25 @@ def check_steps_ran(steps: int, n_examples: int, data_axis_size: int, what: str)
         )
 
 
-def seq_parallel_shard_map(body, mesh: Mesh, axis_name: str):
+def seq_parallel_shard_map(body, mesh: Mesh, axis_name: str, check_vma: bool = True):
     """shard_map wrapper shared by the sequence-parallel attention
     strategies: q,k,v [B, T, H, D] shard as (data?, axis_name, None, None),
     the [B, T] key mask as (data?, axis_name). Keeps ring and Ulysses on one
     contract (mask defaulting and batch-axis resolution live in the callers'
-    shared entry, this is the spec plumbing)."""
+    shared entry, this is the spec plumbing).
+
+    ``check_vma=False`` is needed when the body runs a pallas kernel in
+    interpret mode (the interpreter's internal index constants trip the
+    varying-mesh-axes checker); bodies relying on ``pcast`` must keep it on.
+    """
     from jax.sharding import PartitionSpec as P
 
     batch_axis = "data" if "data" in mesh.axis_names else None
     spec = P(batch_axis, axis_name, None, None)
     mspec = P(batch_axis, axis_name)
     return jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec
+        body, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
+        check_vma=check_vma,
     )
 
 

@@ -30,6 +30,16 @@ def test_ulysses_matches_plain(causal, shape):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
 
 
+def test_ulysses_flash_local_matches_plain():
+    """use_flash=True routes the local attention through the Pallas kernel
+    (interpret mode on CPU) -- results must match the plain path."""
+    q, k, v = _rand_qkv(b=2, t=32, h=8, d=4)
+    mesh = _mesh(1, 8)
+    expected = ulysses_attention(q, k, v, mesh, causal=True)
+    got = ulysses_attention(q, k, v, mesh, causal=True, use_flash=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=5e-5)
+
+
 def test_ulysses_with_padding_mask_matches_ring():
     q, k, v = _rand_qkv()
     rng = np.random.default_rng(1)
