@@ -41,8 +41,10 @@ _BACKENDS: dict[str, str] = {
     "memory": "predictionio_tpu.data.storage.memory",
     "localfs": "predictionio_tpu.data.storage.localfs",
     "postgres": "predictionio_tpu.data.storage.postgres",
-    # reference TYPE name for the scalikejdbc module; postgres URL required
-    "jdbc": "predictionio_tpu.data.storage.postgres",
+    "mysql": "predictionio_tpu.data.storage.mysql",
+    # reference TYPE name for the scalikejdbc module; URL scheme picks
+    # postgres vs mysql (postgres when absent)
+    "jdbc": "predictionio_tpu.data.storage.jdbc",
     "s3": "predictionio_tpu.data.storage.s3",
 }
 

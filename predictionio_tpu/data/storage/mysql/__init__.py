@@ -1,0 +1,5 @@
+"""MySQL storage backend (TYPE=mysql)."""
+
+from predictionio_tpu.data.storage.mysql.client import StorageClient
+
+__all__ = ["StorageClient"]

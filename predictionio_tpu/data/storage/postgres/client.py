@@ -24,8 +24,8 @@ not installed; nothing else in the framework depends on it).
 from __future__ import annotations
 
 import threading
+import uuid
 from typing import Iterator
-from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu.data.storage import sql_common
 from predictionio_tpu.data.storage.base import StorageClientConfig
@@ -112,48 +112,17 @@ _SCHEMA_STATEMENTS = [
 def parse_connection_properties(props: dict[str, str]) -> dict:
     """URL/HOST/PORT/DBNAME/USERNAME/PASSWORD properties -> psycopg2 kwargs.
 
-    Accepts the reference's ``jdbc:postgresql://...`` URL form verbatim.
+    Accepts the reference's ``jdbc:postgresql://...`` URL form verbatim,
+    including JDBC-style query params (?user=..&password=..&sslmode=..).
     """
-    kwargs: dict = {}
-    url = props.get("URL", "")
-    if url:
-        if url.startswith("jdbc:"):
-            url = url[len("jdbc:"):]
-        parsed = urlparse(url)
-        if parsed.scheme not in ("postgresql", "postgres"):
-            raise ValueError(
-                f"unsupported URL scheme {parsed.scheme!r} for postgres storage"
-            )
-        if parsed.hostname:
-            kwargs["host"] = parsed.hostname
-        if parsed.port:
-            kwargs["port"] = parsed.port
-        dbname = (parsed.path or "").lstrip("/")
-        if dbname:
-            kwargs["dbname"] = dbname
-        if parsed.username:
-            kwargs["user"] = parsed.username
-        if parsed.password:
-            kwargs["password"] = parsed.password
-        # JDBC-style query params: ?user=..&password=..&sslmode=.. -- the
-        # standard credential form of the reference's URL contract
-        for key, values in parse_qs(parsed.query).items():
-            if key in ("user", "password", "sslmode", "connect_timeout"):
-                kwargs[key] = values[-1]
-    if props.get("HOST"):
-        kwargs["host"] = props["HOST"]
-    if props.get("PORT"):
-        kwargs["port"] = int(props["PORT"])
-    if props.get("DBNAME"):
-        kwargs["dbname"] = props["DBNAME"]
-    if props.get("USERNAME"):
-        kwargs["user"] = props["USERNAME"]
-    if props.get("PASSWORD"):
-        kwargs["password"] = props["PASSWORD"]
-    kwargs.setdefault("host", "localhost")
-    kwargs.setdefault("port", 5432)
-    kwargs.setdefault("dbname", "pio")
-    return kwargs
+    return sql_common.parse_jdbc_url_properties(
+        props,
+        schemes=("postgresql", "postgres"),
+        backend_name="postgres",
+        default_port=5432,
+        dbname_key="dbname",
+        query_keys=("user", "password", "sslmode", "connect_timeout"),
+    )
 
 
 class StorageClient(sql_common.SQLStorageClient):
@@ -179,6 +148,7 @@ class StorageClient(sql_common.SQLStorageClient):
                 " switch PIO_STORAGE_SOURCES_*_TYPE to 'sqlite'"
             ) from exc
         kwargs = parse_connection_properties(config.properties)
+        self._connect_kwargs = kwargs
         self._conn = psycopg2.connect(**kwargs)
         self._lock = threading.RLock()
         # `with conn:` = one transaction (commit on exit, rollback on error),
@@ -190,12 +160,12 @@ class StorageClient(sql_common.SQLStorageClient):
     def execute(self, sql: str, params: tuple = ()):
         with self._lock, self._conn, self._conn.cursor() as cur:
             cur.execute(sql, params)
-            return _Result(cur.rowcount)
+            return sql_common.CursorResult(cur.rowcount)
 
     def executemany(self, sql: str, rows: list[tuple]):
         with self._lock, self._conn, self._conn.cursor() as cur:
             cur.executemany(sql, rows)
-            return _Result(cur.rowcount)
+            return sql_common.CursorResult(cur.rowcount)
 
     def insert_returning_id(self, sql: str, params: tuple) -> int:
         with self._lock, self._conn, self._conn.cursor() as cur:
@@ -208,16 +178,26 @@ class StorageClient(sql_common.SQLStorageClient):
             return cur.fetchall()
 
     def query_iter(self, sql: str, params: tuple = ()) -> Iterator[tuple]:
-        # a default psycopg2 cursor pulls the whole result client-side at
-        # execute() anyway, so materialize under the lock and yield outside
-        # it -- never holding the client-wide lock across consumer yields
-        yield from self.query(sql, params)
+        """Stream via a server-side (named) cursor on a dedicated connection,
+        mirroring the sqlite streaming path: a multi-GB event scan (train
+        reads, export, aggregate_properties) never materializes client-side
+        and never holds the client-wide lock across consumer yields."""
+        import psycopg2
+
+        conn = psycopg2.connect(**self._connect_kwargs)
+        try:
+            with conn, conn.cursor(name=f"pio_scan_{id(self)}_{uuid.uuid4().hex[:8]}") as cur:
+                cur.execute(sql, params)
+                while True:
+                    rows = cur.fetchmany(1024)
+                    if not rows:
+                        return
+                    yield from rows
+        finally:
+            conn.close()
 
     def close(self) -> None:
         with self._lock:
             self._conn.close()
 
 
-class _Result:
-    def __init__(self, rowcount: int):
-        self.rowcount = rowcount

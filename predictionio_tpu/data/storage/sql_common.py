@@ -35,6 +35,80 @@ from predictionio_tpu.data.storage.base import (
 #: channel_id column value for the default channel (reference uses None).
 DEFAULT_CHANNEL = 0
 
+#: find(limit=N) at or under this uses the plain materializing query path --
+#: a handful of rows never justifies a dedicated streaming connection (the
+#: event server's GET /events.json hot path runs find(limit=20) per request)
+SMALL_SCAN_LIMIT = 1000
+
+
+class CursorResult:
+    """Minimal ``rowcount`` carrier for backends whose cursors are closed
+    before the DAO inspects the result."""
+
+    def __init__(self, rowcount: int):
+        self.rowcount = rowcount
+
+
+def parse_jdbc_url_properties(
+    props: dict[str, str],
+    schemes: tuple[str, ...],
+    backend_name: str,
+    default_port: int,
+    dbname_key: str = "dbname",
+    query_keys: tuple[str, ...] = ("user", "password", "connect_timeout"),
+) -> dict:
+    """Shared URL/HOST/PORT/DBNAME/USERNAME/PASSWORD -> DB-API kwargs parsing.
+
+    One copy serves every SQL dialect (the reference's JDBCUtils analogue):
+    accepts the reference's ``jdbc:<scheme>://...`` URL form verbatim, with
+    explicit HOST/PORT/DBNAME/USERNAME/PASSWORD properties overriding URL
+    parts, and scheme validation against the dialect's accepted set.
+    """
+    from urllib.parse import parse_qs, urlparse
+
+    kwargs: dict = {}
+    url = props.get("URL", "")
+    if url:
+        if url.startswith("jdbc:"):
+            url = url[len("jdbc:"):]
+        parsed = urlparse(url)
+        if parsed.scheme not in schemes:
+            raise ValueError(
+                f"unsupported URL scheme {parsed.scheme!r} for {backend_name} storage"
+            )
+        if parsed.hostname:
+            kwargs["host"] = parsed.hostname
+        if parsed.port:
+            kwargs["port"] = parsed.port
+        dbname = (parsed.path or "").lstrip("/")
+        if dbname:
+            kwargs[dbname_key] = dbname
+        if parsed.username:
+            kwargs["user"] = parsed.username
+        if parsed.password:
+            kwargs["password"] = parsed.password
+        for key, values in parse_qs(parsed.query).items():
+            if key in query_keys:
+                value = values[-1]
+                # drivers with C connect paths (PyMySQL/MySQLdb) require
+                # real ints for numeric options; psycopg2 merely tolerates
+                # strings
+                kwargs[key] = int(value) if value.isdigit() else value
+    if props.get("HOST"):
+        kwargs["host"] = props["HOST"]
+    if props.get("PORT"):
+        kwargs["port"] = int(props["PORT"])
+    if props.get("DBNAME"):
+        kwargs[dbname_key] = props["DBNAME"]
+    if props.get("USERNAME"):
+        kwargs["user"] = props["USERNAME"]
+    if props.get("PASSWORD"):
+        kwargs["password"] = props["PASSWORD"]
+    kwargs.setdefault("host", "localhost")
+    kwargs.setdefault("port", default_port)
+    kwargs.setdefault(dbname_key, "pio")
+    return kwargs
+
 
 def ts_to_str(ts: _dt.datetime | None) -> str | None:
     # normalize to UTC with fixed precision so text ORDER BY is chronological
@@ -616,5 +690,10 @@ class SQLLEvents(base.LEvents):
         if limit is not None and limit >= 0:
             sql.append("LIMIT ?")
             params.append(limit)
-        for r in self.c.query_iter(self.c.sql(" ".join(sql)), tuple(params)):
+        # small bounded scans (the event server's GET hot path runs
+        # find(limit=20) per request) take the plain query path; only
+        # unbounded/large scans pay for a dedicated streaming connection
+        small = limit is not None and 0 <= limit <= SMALL_SCAN_LIMIT
+        runner = self.c.query if small else self.c.query_iter
+        for r in runner(self.c.sql(" ".join(sql)), tuple(params)):
             yield self._row_to_event(r)
