@@ -12,8 +12,10 @@ zero-egress container): 138k users x 27k items x 20M implicit-ish ratings
 with zipf item popularity, per-user history capped at 256 (padded-CSR
 truncation, the ALX-style layout choice).
 
-Prints ONE JSON line. Env knobs: PIO_BENCH_SCALE (edge count divisor for
-smoke runs), PIO_BENCH_PLATFORM=cpu to skip the TPU.
+Prints ONE JSON line and writes a ``BENCH_evidence.json`` sidecar (device
+kind, per-run timings, an MFU estimate). Env knobs: PIO_BENCH_SCALE (edge
+count divisor for smoke runs), PIO_BENCH_PLATFORM=cpu to skip the TPU,
+PIO_BENCH_PROBE_BUDGET_S (total TPU probe budget, default 300).
 """
 
 from __future__ import annotations
@@ -22,6 +24,8 @@ import json
 import os
 import sys
 import time
+
+EVIDENCE: dict = {"probes": [], "runs": {}}
 
 
 def make_dataset(n_edges: int, n_users: int, n_items: int, seed: int = 0):
@@ -48,39 +52,83 @@ def run_als(platform: str, data, config, iters_to_time: int) -> float:
     Compilation is cached across the runs (same mesh + hyperparameters),
     and the constant costs -- host->device transfer of the CSR blocks,
     factor init, final fetch -- subtract out.
+
+    A delta below 10% of the long run is re-measured once with 2x the
+    iteration count; if still degenerate the run is recorded as invalid
+    rather than clamped to an absurd iters/sec.
     """
+    import dataclasses
+
     import jax
+    import numpy as np
+    from jax.sharding import Mesh
 
     from predictionio_tpu.parallel import als as als_mod
-    from jax.sharding import Mesh
-    import numpy as np
 
     devices = jax.devices(platform)
     mesh = Mesh(np.array(devices[:1]).reshape(1, 1), ("data", "model"))
 
-    import dataclasses
+    def measure(k: int) -> tuple[float, float, float]:
+        one = dataclasses.replace(config, iterations=1)
+        many = dataclasses.replace(config, iterations=1 + k)
+        t0 = time.perf_counter()
+        als_mod.als_fit(data, one, mesh)
+        w_one = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        als_mod.als_fit(data, many, mesh)
+        w_many = time.perf_counter() - t0
+        return w_one, w_many, (w_many - w_one) / k
 
-    one = dataclasses.replace(config, iterations=1)
-    many = dataclasses.replace(config, iterations=1 + iters_to_time)
-    als_mod.als_fit(data, one, mesh)  # warmup: compile + device transfer
+    warm = dataclasses.replace(config, iterations=1)
     t0 = time.perf_counter()
-    als_mod.als_fit(data, one, mesh)
-    w_one = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    als_mod.als_fit(data, many, mesh)
-    w_many = time.perf_counter() - t0
-    return max(w_many - w_one, 1e-9) / iters_to_time
+    als_mod.als_fit(data, warm, mesh)  # warmup: compile + device transfer
+    compile_s = time.perf_counter() - t0
+
+    w_one, w_many, per_iter = measure(iters_to_time)
+    record = {
+        "device": str(devices[0]),
+        "compile_and_first_run_s": round(compile_s, 3),
+        "w_one_s": round(w_one, 4),
+        "w_many_s": round(w_many, 4),
+        "iters_timed": iters_to_time,
+        "sec_per_iter": round(per_iter, 5),
+        "valid": True,
+    }
+    if w_many - w_one < 0.1 * w_many:
+        # noise-dominated delta: re-measure once with a longer run before
+        # trusting (or reporting) anything
+        w_one2, w_many2, per_iter2 = measure(iters_to_time * 2)
+        record.update(
+            remeasured=True,
+            w_one_s=round(w_one2, 4),
+            w_many_s=round(w_many2, 4),
+            iters_timed=iters_to_time * 2,
+            sec_per_iter=round(per_iter2, 5),
+        )
+        per_iter = per_iter2
+        if w_many2 - w_one2 < 0.1 * w_many2:
+            record["valid"] = False
+    EVIDENCE["runs"][platform] = record
+    if not record["valid"] or per_iter <= 0:
+        raise RuntimeError(
+            f"degenerate timing on {platform}: w_one={record['w_one_s']}"
+            f" w_many={record['w_many_s']} -- delta below noise floor"
+        )
+    return per_iter
 
 
-def _probe_tpu(timeout_s: int = 120) -> str | None:
+def _probe_tpu_once(timeout_s: int) -> tuple[str | None, str]:
     """Check TPU reachability in a SUBPROCESS: a wedged axon tunnel blocks
-    backend init indefinitely in-process, which would hang the whole bench."""
+    backend init indefinitely in-process, which would hang the whole bench.
+    Returns (platform or None, diagnostic)."""
     import subprocess
 
     code = (
         "import jax\n"
         "ds = jax.devices()\n"
-        "print(ds[0].platform)\n"
+        "import jax.numpy as jnp\n"
+        "x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()\n"
+        "print('PLATFORM=' + ds[0].platform)\n"
     )
     try:
         proc = subprocess.run(
@@ -89,20 +137,75 @@ def _probe_tpu(timeout_s: int = 120) -> str | None:
             text=True,
             timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
-        return None
+    except subprocess.TimeoutExpired as exc:
+        tail = ((exc.stderr or b"").decode("utf-8", "replace"))[-500:]
+        return None, f"timeout after {timeout_s}s; stderr tail: {tail!r}"
     if proc.returncode != 0:
-        return None
-    platform = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
-    return platform if platform and platform != "cpu" else None
+        return None, f"exit {proc.returncode}; stderr tail: {proc.stderr[-500:]!r}"
+    platform = ""
+    for line in proc.stdout.strip().splitlines():
+        if line.startswith("PLATFORM="):
+            platform = line[len("PLATFORM="):]
+    if platform and platform != "cpu":
+        return platform, f"ok ({platform})"
+    return None, f"backend resolved to {platform or 'nothing'!r} (not an accelerator)"
+
+
+def probe_tpu(total_budget_s: int) -> str | None:
+    """Escalating-timeout probes (60/120/240...s) until the budget is spent.
+
+    Round 1 failed here: two fixed 120s probes timed out in the driver
+    environment and the bench silently fell back to CPU, leaving the
+    round's primary metric unproven. Every attempt's diagnostic is kept in
+    the evidence sidecar so a fallback is at least explained.
+    """
+    spent = 0.0
+    timeout = 60
+    attempt = 0
+    while spent < total_budget_s:
+        attempt += 1
+        budgeted = min(timeout, max(30, total_budget_s - spent))
+        t0 = time.perf_counter()
+        platform, diag = _probe_tpu_once(int(budgeted))
+        elapsed = time.perf_counter() - t0
+        spent += elapsed
+        EVIDENCE["probes"].append(
+            {
+                "attempt": attempt,
+                "timeout_s": int(budgeted),
+                "elapsed_s": round(elapsed, 1),
+                "result": diag,
+            }
+        )
+        if platform:
+            return platform
+        timeout *= 2
+        time.sleep(min(10, max(0, total_budget_s - spent)))
+        spent += 10
+    return None
+
+
+def als_flops_per_iteration(data, rank: int) -> float:
+    """FLOPs of one full ALS iteration (both half-steps) on the padded data.
+
+    Per half-step over R rows of padded length L with K=rank:
+    Gram einsum rlk,rlj->rkj = 2*R*L*K^2; rhs = 2*R*L*K; batched Cholesky
+    solve ~ R*(K^3/3 + 2K^2). Padding rows count: the device computes them.
+    """
+    total = 0.0
+    for csr in (data.by_row, data.by_col):
+        rows, pad_len = csr.indices.shape
+        k = float(rank)
+        total += 2 * rows * pad_len * k * k      # gram
+        total += 2 * rows * pad_len * k          # rhs
+        total += rows * (k ** 3 / 3 + 2 * k * k)  # solve
+    return total
 
 
 def main() -> None:
     want_tpu = os.environ.get("PIO_BENCH_PLATFORM", "tpu") != "cpu"
-    tpu_platform = _probe_tpu() if want_tpu else None
-    if want_tpu and tpu_platform is None:
-        time.sleep(30)  # transient tunnel wedges sometimes clear; one retry
-        tpu_platform = _probe_tpu()
+    budget = int(os.environ.get("PIO_BENCH_PROBE_BUDGET_S", "300"))
+    tpu_platform = probe_tpu(budget) if want_tpu else None
 
     import jax
 
@@ -120,25 +223,61 @@ def main() -> None:
     config = ALSConfig(rank=16, reg=0.05, max_len=256)
     data = build_als_data(users, items, ratings, n_users, n_items, config)
 
-    cpu_secs = run_als("cpu", data, config, 2)
-    if tpu_platform:
-        tpu_secs = run_als(tpu_platform, data, config, 5)
-        value = 1.0 / tpu_secs
-        vs_baseline = cpu_secs / tpu_secs
-        note = f"tpu({tpu_platform}) vs host-cpu baseline {1.0 / cpu_secs:.3f} it/s"
-    else:
-        value = 1.0 / cpu_secs
-        vs_baseline = 1.0
-        note = "cpu only (no TPU backend reachable)"
+    def attempt() -> dict:
+        cpu_secs = run_als("cpu", data, config, 2)
+        if tpu_platform:
+            tpu_secs = run_als(tpu_platform, data, config, 5)
+            flops = als_flops_per_iteration(data, config.rank)
+            achieved = flops / tpu_secs
+            # v5e-1 peak: ~197 TFLOP/s bf16 (f32 accumulation); the solver
+            # runs f32 Grams, so this MFU is a conservative lower bound
+            EVIDENCE["mfu"] = {
+                "flops_per_iteration": flops,
+                "achieved_flops_per_s": achieved,
+                "peak_bf16_flops_per_s": 197e12,
+                "mfu_vs_bf16_peak": round(achieved / 197e12, 4),
+            }
+            return {
+                "value": round(1.0 / tpu_secs, 4),
+                "vs_baseline": round(cpu_secs / tpu_secs, 3),
+                "note": (
+                    f"tpu({tpu_platform}) vs host-cpu baseline"
+                    f" {1.0 / cpu_secs:.3f} it/s;"
+                    f" mfu~{EVIDENCE['mfu']['mfu_vs_bf16_peak']:.1%} of bf16 peak"
+                ),
+            }
+        if not want_tpu:
+            note = "cpu only (PIO_BENCH_PLATFORM=cpu)"
+        else:
+            probe_tail = "; ".join(p["result"] for p in EVIDENCE["probes"][-2:])
+            note = f"cpu only (no TPU backend reachable: {probe_tail})"[:400]
+        return {
+            "value": round(1.0 / cpu_secs, 4),
+            "vs_baseline": 1.0,
+            "note": note,
+        }
+
+    try:
+        try:
+            result = attempt()
+        except Exception as exc:  # one full retry before giving up
+            EVIDENCE["first_attempt_error"] = repr(exc)
+            result = attempt()
+    finally:
+        # evidence must land even when both attempts fail -- a stale sidecar
+        # from an earlier run would misattribute its numbers to this one
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_evidence.json"), "w") as f:
+            json.dump(EVIDENCE, f, indent=1)
 
     print(
         json.dumps(
             {
                 "metric": "als_iters_per_sec_per_chip_ml20m_scale",
-                "value": round(value, 4),
+                "value": result["value"],
                 "unit": "iters/sec",
-                "vs_baseline": round(vs_baseline, 3),
-                "note": note,
+                "vs_baseline": result["vs_baseline"],
+                "note": result["note"],
                 "edges": n_edges,
             }
         )

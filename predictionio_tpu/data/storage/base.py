@@ -213,6 +213,25 @@ class EngineInstances(abc.ABC):
         self, engine_id: str, engine_version: str, engine_variant: str
     ) -> list[EngineInstance]: ...
 
+    def get_latest(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        """Most recent instance for a variant REGARDLESS of status -- the
+        crash-resume lookup (`pio train --resume` reuses a non-COMPLETED
+        instance instead of inserting a new one). Default implementation
+        scans get_all(); SQL backends override with a WHERE query."""
+        candidates = [
+            i
+            for i in self.get_all()
+            if i.engine_id == engine_id
+            and i.engine_version == engine_version
+            and i.engine_variant == engine_variant
+        ]
+        if not candidates:
+            return None
+        epoch = _dt.datetime.min.replace(tzinfo=_dt.timezone.utc)
+        return max(candidates, key=lambda i: i.start_time or epoch)
+
     @abc.abstractmethod
     def update(self, instance: EngineInstance) -> None: ...
 

@@ -90,10 +90,12 @@ def parse_jdbc_url_properties(
         for key, values in parse_qs(parsed.query).items():
             if key in query_keys:
                 value = values[-1]
-                # drivers with C connect paths (PyMySQL/MySQLdb) require
-                # real ints for numeric options; psycopg2 merely tolerates
-                # strings
-                kwargs[key] = int(value) if value.isdigit() else value
+                # MySQL drivers require a real int for connect_timeout;
+                # credentials must stay strings even when all-digit
+                if key == "connect_timeout" and value.isdigit():
+                    kwargs[key] = int(value)
+                else:
+                    kwargs[key] = value
     if props.get("HOST"):
         kwargs["host"] = props["HOST"]
     if props.get("PORT"):
@@ -379,6 +381,19 @@ class SQLEngineInstances(base.EngineInstances):
     ) -> Optional[EngineInstance]:
         completed = self.get_completed(engine_id, engine_version, engine_variant)
         return completed[0] if completed else None
+
+    def get_latest(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        rows = self.c.query(
+            self.c.sql(
+                f"SELECT {self._COLS} FROM engine_instances WHERE engine_id=?"
+                " AND engine_version=? AND engine_variant=?"
+                " ORDER BY start_time DESC LIMIT 1"
+            ),
+            (engine_id, engine_version, engine_variant),
+        )
+        return self._row_to_instance(rows[0]) if rows else None
 
     def update(self, instance: EngineInstance) -> None:
         self.c.execute(

@@ -71,13 +71,11 @@ class NCFAlgorithm(TPUAlgorithm):
                 np.random.default_rng(config.seed),
             )
         checkpoint = None
-        if p.get_or("checkpoint", False):
-            from predictionio_tpu.workflow.checkpoint import CheckpointManager
-
-            # key on the engine-instance id when the workflow provides one;
-            # programmatic callers get a params-stable key
-            run_id = getattr(ctx, "instance_id", None) or f"seed{config.seed}"
-            checkpoint = CheckpointManager(f"ncf-{run_id}")
+        if p.get_or("checkpoint", True):
+            # keyed on the workflow's stable run_key (variant+params hash),
+            # so `pio train --resume` after preemption finds the crashed
+            # attempt's epochs -- the round-1 instance-id key could not
+            checkpoint = ctx.checkpoint_manager("ncf")
         params, _ = train_ncf(
             config, users, items, labels, ctx.mesh, checkpoint=checkpoint
         )

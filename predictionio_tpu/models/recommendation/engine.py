@@ -158,7 +158,9 @@ class RecommendationModel:
 class ALSAlgorithm(TPUAlgorithm):
     """ALS on the device mesh (MLlib ALS / ALS.trainImplicit parity).
 
-    Params: rank, numIterations, lambda, alpha, implicitPrefs, seed.
+    Params: rank, numIterations, lambda, alpha, implicitPrefs, seed,
+    checkpointInterval (iterations between step checkpoints; 0 disables --
+    the preemption-safety net `pio train --resume` continues from).
     """
 
     def _config(self) -> ALSConfig:
@@ -180,7 +182,42 @@ class ALSAlgorithm(TPUAlgorithm):
             mesh = ctx.mesh
         except Exception:
             mesh = None
-        model = als_fit(als_data, config, mesh)
+        interval = self.params.get_or("checkpointInterval", 5)
+        checkpoint = ctx.checkpoint_manager("als") if interval > 0 else None
+        init, start_iteration, callback = None, 0, None
+        if checkpoint is not None:
+            latest = checkpoint.latest_step()
+            if latest is not None:  # only a --resume run can see a step here
+                state = checkpoint.restore(
+                    {
+                        "users": np.zeros(
+                            (ratings_data.num_users, config.rank), np.float32
+                        ),
+                        "items": np.zeros(
+                            (ratings_data.num_items, config.rank), np.float32
+                        ),
+                        "iteration": 0,
+                    }
+                )
+                init = (state["users"], state["items"])
+                start_iteration = int(state["iteration"]) + 1
+
+            def callback(it, users_np, items_np):
+                checkpoint.save(
+                    it, {"users": users_np, "items": items_np, "iteration": it}
+                )
+
+        model = als_fit(
+            als_data,
+            config,
+            mesh,
+            callback=callback,
+            callback_interval=interval,
+            init=init,
+            start_iteration=start_iteration,
+        )
+        if checkpoint is not None:
+            checkpoint.close()
         seen: dict[int, set[int]] = {}
         for u, i in zip(ratings_data.users, ratings_data.items):
             seen.setdefault(int(u), set()).add(int(i))

@@ -186,13 +186,21 @@ def als_fit(
     config: ALSConfig,
     mesh=None,
     callback=None,
+    callback_interval: int = 1,
+    init: tuple[np.ndarray, np.ndarray] | None = None,
+    start_iteration: int = 0,
 ) -> ALSModel:
     """Run ALS to convergence budget; returns host-side factor matrices.
 
-    ``callback(iteration, user_factors, item_factors)`` runs per iteration
-    (checkpointing hook). Factor buffers are donated between iterations, so
-    a callback must copy (``np.asarray``) anything it wants to keep -- the
-    device arrays it receives are consumed by the next iteration.
+    ``callback(iteration, user_factors, item_factors)`` runs every
+    ``callback_interval`` iterations (skipping the final one, whose result
+    als_fit returns anyway) with HOST numpy copies (safe to retain -- the
+    checkpointing hook; the on-device buffers are donated between
+    iterations and must not escape). The interval lives HERE so
+    non-callback iterations never pay the device sync + host copy that
+    materializing the factors costs. ``init``/``start_iteration`` resume
+    from checkpointed factors: the remaining iterations run, which is exact
+    for ALS (each iteration depends only on the previous factors).
     ``mesh`` defaults to a 1-device local mesh.
     """
     from predictionio_tpu.parallel.mesh import local_mesh
@@ -209,12 +217,22 @@ def als_fit(
         real = rng.normal(size=(num_real, config.rank)) * scale
         return np.pad(real, ((0, num_padded - num_real), (0, 0)))
 
-    users0 = init_factors(
-        data.by_row.num_rows, data.by_row.indices.shape[0], config.seed
-    )
-    items0 = init_factors(
-        data.by_col.num_rows, data.by_col.indices.shape[0], config.seed + 1
-    )
+    if init is not None:
+        users0 = np.pad(
+            np.asarray(init[0]),
+            ((0, data.by_row.indices.shape[0] - init[0].shape[0]), (0, 0)),
+        )
+        items0 = np.pad(
+            np.asarray(init[1]),
+            ((0, data.by_col.indices.shape[0] - init[1].shape[0]), (0, 0)),
+        )
+    else:
+        users0 = init_factors(
+            data.by_row.num_rows, data.by_row.indices.shape[0], config.seed
+        )
+        items0 = init_factors(
+            data.by_col.num_rows, data.by_col.indices.shape[0], config.seed + 1
+        )
 
     row = NamedSharding(mesh, PartitionSpec("data"))
     put_row = lambda a: jax.device_put(a, row)
@@ -230,12 +248,23 @@ def als_fit(
 
     iteration = make_iteration(mesh, config)
 
-    for it in range(config.iterations):
+    for it in range(start_iteration, config.iterations):
         user_factors, item_factors = iteration(
             u_idx, u_val, u_msk, i_idx, i_val, i_msk, user_factors, item_factors
         )
-        if callback is not None:
-            callback(it, user_factors, item_factors)
+        if (
+            callback is not None
+            and (it + 1) % callback_interval == 0
+            and it + 1 < config.iterations
+        ):
+            # host copies: the device buffers are donated into the next
+            # iteration; handing them out would raise 'Array has been
+            # deleted' one iteration later, far from the cause
+            callback(
+                it,
+                np.asarray(user_factors)[: data.by_row.num_rows].copy(),
+                np.asarray(item_factors)[: data.by_col.num_rows].copy(),
+            )
 
     user_np = np.asarray(user_factors)[: data.by_row.num_rows]
     item_np = np.asarray(item_factors)[: data.by_col.num_rows]

@@ -22,6 +22,12 @@ def register(sub: argparse._SubParsersAction) -> None:
     _add_variant_args(train)
     train.add_argument("--batch", default="", help="batch label recorded on the instance")
     train.add_argument("--skip-sanity-check", action="store_true")
+    train.add_argument(
+        "--resume",
+        action="store_true",
+        help="continue the variant's latest crashed/preempted run from its"
+        " step checkpoints instead of starting over",
+    )
     train.add_argument("passthrough", nargs="*", help="runtime conf after --")
     train.set_defaults(func=cmd_train)
 
@@ -84,7 +90,11 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     variant = _load_variant(args)
     variant.runtime_conf.update(_parse_passthrough(args.passthrough))
-    params = WorkflowParams(batch=args.batch, skip_sanity_check=args.skip_sanity_check)
+    params = WorkflowParams(
+        batch=args.batch,
+        skip_sanity_check=args.skip_sanity_check,
+        resume=args.resume,
+    )
     instance = run_train(variant, params)
     print(f"Training completed. Engine instance ID: {instance.id}")
     return 0

@@ -17,9 +17,20 @@ logger = logging.getLogger("pio.checkpoint")
 
 
 class CheckpointManager:
-    """Thin orbax wrapper keyed by engine-instance/run id."""
+    """Thin orbax wrapper keyed by a stable run key.
 
-    def __init__(self, run_id: str, base_dir: str | None = None, max_to_keep: int = 3):
+    ``fresh=True`` (a non-resume train) deletes any existing checkpoints
+    under the key first, so stale checkpoints from an earlier run with the
+    same params never short-circuit a from-scratch retrain.
+    """
+
+    def __init__(
+        self,
+        run_id: str,
+        base_dir: str | None = None,
+        max_to_keep: int = 3,
+        fresh: bool = False,
+    ):
         import orbax.checkpoint as ocp
 
         base = base_dir or os.path.join(
@@ -27,6 +38,10 @@ class CheckpointManager:
             "checkpoints",
         )
         self.path = os.path.abspath(os.path.join(base, run_id))
+        if fresh and os.path.isdir(self.path):
+            import shutil
+
+            shutil.rmtree(self.path)
         os.makedirs(self.path, exist_ok=True)
         self._manager = ocp.CheckpointManager(
             self.path,
@@ -54,3 +69,18 @@ class CheckpointManager:
     def close(self) -> None:
         self._manager.wait_until_finished()
         self._manager.close()
+
+
+def clear_run_checkpoints(run_key: str, base_dir: str | None = None) -> None:
+    """Delete every algorithm's checkpoints for a run key (called after a
+    COMPLETED train: the model blob is persisted, step checkpoints are dead
+    weight -- and must not be resumable into a later retrain)."""
+    import glob
+    import shutil
+
+    base = base_dir or os.path.join(
+        os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store")),
+        "checkpoints",
+    )
+    for path in glob.glob(os.path.join(base, f"*-{run_key}")):
+        shutil.rmtree(path, ignore_errors=True)

@@ -34,6 +34,11 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    #: `pio train --resume`: reuse the variant's latest non-COMPLETED
+    #: EngineInstance and continue from its step checkpoints instead of
+    #: starting over (SURVEY.md section 5.3/5.4 -- the reference has no
+    #: mid-training resume; on TPU preemption safety requires it)
+    resume: bool = False
 
 
 class RuntimeContext:
@@ -47,15 +52,43 @@ class RuntimeContext:
         self,
         runtime_conf: Mapping[str, Any] | None = None,
         instance_id: str | None = None,
+        run_key: str | None = None,
+        resume: bool = False,
     ):
         self.runtime_conf: dict[str, Any] = dict(runtime_conf or {})
-        #: engine-instance id of the current run (set by the train workflow;
-        #: algorithms key step checkpoints on it)
+        #: engine-instance id of the current run (set by the train workflow)
         self.instance_id = instance_id
+        #: stable checkpoint key: hash of (variant id, version, params) --
+        #: UNLIKE instance_id it survives re-running `pio train`, so a
+        #: resumed run finds the crashed run's checkpoints
+        self.run_key = run_key
+        #: True on `pio train --resume`: checkpoint_manager keeps existing
+        #: checkpoints; a fresh train wipes them (stale checkpoints must not
+        #: silently short-circuit a from-scratch retrain)
+        self.resume = resume
         #: per-stage wall-clock seconds, filled by Engine.train (the
         #: observability the reference delegated to the Spark UI, SURVEY 5.1)
         self.timings: dict[str, float] = {}
         self._mesh = None
+
+    def checkpoint_manager(self, name: str):
+        """Step-checkpoint manager for an algorithm (orbax-backed), or None.
+
+        Keyed on the stable run_key so `pio train --resume` after a crash
+        finds the previous attempt's checkpoints. On a NON-resume run any
+        existing checkpoints under the key are deleted first. Contexts
+        without a run key (evaluation grid candidates, ad-hoc programmatic
+        trains) get None -- those runs are not resumable, and a shared
+        fallback key would make concurrent trains race on one directory.
+        Programmatic callers who want checkpoints pass an explicit
+        ``run_key`` to RuntimeContext.
+        """
+        key = self.run_key or self.instance_id
+        if key is None:
+            return None
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+        return CheckpointManager(f"{name}-{key}", fresh=not self.resume)
 
     # -- mesh construction --------------------------------------------------
     @property

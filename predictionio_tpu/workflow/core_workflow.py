@@ -57,6 +57,21 @@ def _pio_env() -> dict[str, str]:
     }
 
 
+def _run_key(variant: EngineVariant, params_jsons: tuple[str, ...]) -> str:
+    """Stable checkpoint key: same variant + same FULL params (datasource,
+    preparator, algorithms, serving) -> same key, so a rerun after
+    preemption locates the crashed attempt's checkpoints (the round-1
+    instance-id key made resume dead code: every rerun got a fresh
+    checkpoint dir). Any params change -> different key: checkpoints from
+    different data or hyperparameters must never cross-resume."""
+    import hashlib
+
+    material = "\x1f".join(
+        (variant.variant_id, variant.engine_version, variant.path, *params_jsons)
+    )
+    return hashlib.sha256(material.encode()).hexdigest()[:16]
+
+
 def run_train(
     variant: EngineVariant,
     workflow_params: WorkflowParams | None = None,
@@ -65,31 +80,81 @@ def run_train(
     """The `pio train` core: returns the COMPLETED EngineInstance.
 
     Raises after recording FAILED status if any DASE stage throws.
+    With ``workflow_params.resume`` the variant's latest non-COMPLETED
+    instance is reused and algorithms continue from their step checkpoints.
     """
     workflow_params = workflow_params or WorkflowParams()
     engine = engine or build_engine(variant)
     engine_params = variant.engine_params
     instances = storage.get_meta_data_engine_instances()
 
-    instance = EngineInstance(
-        status=STATUS_RUNNING,
-        start_time=_utcnow(),
-        engine_id=variant.variant_id,
-        engine_version=variant.engine_version,
-        engine_variant=variant.path,
-        engine_factory=variant.engine_factory,
-        batch=workflow_params.batch,
-        env=_pio_env(),
-        runtime_conf=strip_launch_conf(variant.runtime_conf),
-        data_source_params=json.dumps(dict(engine_params.data_source_params)),
-        preparator_params=json.dumps(dict(engine_params.preparator_params)),
-        algorithms_params=json.dumps(
-            [{"name": n, "params": dict(p)} for n, p in engine_params.algorithm_params_list]
+    params_jsons = (
+        json.dumps(dict(engine_params.data_source_params)),
+        json.dumps(dict(engine_params.preparator_params)),
+        json.dumps(
+            [
+                {"name": n, "params": dict(p)}
+                for n, p in engine_params.algorithm_params_list
+            ]
         ),
-        serving_params=json.dumps(dict(engine_params.serving_params)),
+        json.dumps(dict(engine_params.serving_params)),
     )
-    instance_id = instances.insert(instance)
-    ctx = RuntimeContext(variant.runtime_conf, instance_id=instance_id)
+    ds_json, prep_json, algorithms_params_json, serving_json = params_jsons
+    instance = None
+    resume = False
+    if workflow_params.resume:
+        prior = instances.get_latest(
+            variant.variant_id, variant.engine_version, variant.path
+        )
+        if prior is not None and prior.status != STATUS_COMPLETED:
+            # the FULL params must match: resuming ALS factors checkpointed
+            # against a different dataset (changed datasource params) would
+            # silently misalign factors with the new id vocabulary
+            prior_params = (
+                prior.data_source_params,
+                prior.preparator_params,
+                prior.algorithms_params,
+                prior.serving_params,
+            )
+            if prior_params == params_jsons:
+                instance = prior
+                instance.status = STATUS_RUNNING
+                instance.end_time = None
+                instances.update(instance)
+                resume = True
+                logger.info(
+                    "resuming engine instance %s (was %s)", prior.id, prior.status
+                )
+            else:
+                logger.warning(
+                    "--resume requested but params changed since instance %s;"
+                    " starting fresh",
+                    prior.id,
+                )
+    if instance is None:
+        instance = EngineInstance(
+            status=STATUS_RUNNING,
+            start_time=_utcnow(),
+            engine_id=variant.variant_id,
+            engine_version=variant.engine_version,
+            engine_variant=variant.path,
+            engine_factory=variant.engine_factory,
+            batch=workflow_params.batch,
+            env=_pio_env(),
+            runtime_conf=strip_launch_conf(variant.runtime_conf),
+            data_source_params=ds_json,
+            preparator_params=prep_json,
+            algorithms_params=algorithms_params_json,
+            serving_params=serving_json,
+        )
+        instances.insert(instance)
+    instance_id = instance.id
+    ctx = RuntimeContext(
+        variant.runtime_conf,
+        instance_id=instance_id,
+        run_key=_run_key(variant, params_jsons),
+        resume=resume,
+    )
     profile_dir = variant.runtime_conf.get("pio.profile")
     try:
         if profile_dir:
@@ -111,6 +176,11 @@ def run_train(
         instance.status = STATUS_COMPLETED
         instance.end_time = _utcnow()
         instances.update(instance)
+        # model persisted -> step checkpoints are dead weight (and must not
+        # silently resume into a later from-scratch retrain)
+        from predictionio_tpu.workflow.checkpoint import clear_run_checkpoints
+
+        clear_run_checkpoints(ctx.run_key)
         logger.info("training finished: instance %s", instance_id)
         return instance
     except Exception:

@@ -1,0 +1,333 @@
+"""Crash-resume through the train workflow (SURVEY.md section 5.3/5.4:
+re-entrant train resuming from the last checkpoint -- a NEW capability the
+reference lacked; Spark lineage was its failure story).
+
+Covers: run_key stability, instance reuse on --resume, checkpoint wipe on
+fresh trains, resumed-model == uninterrupted-model, and a real
+kill-and-rerun e2e through the CLI in subprocesses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import (
+    STATUS_COMPLETED,
+    STATUS_FAILED,
+    App,
+)
+from predictionio_tpu.workflow.context import WorkflowParams
+from predictionio_tpu.workflow.core_workflow import run_train
+from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def seed_ratings(storage_env, n_users=12, n_items=8) -> int:
+    apps = storage_env.get_meta_data_apps()
+    app_id = apps.insert(App(name="RateApp"))
+    le = storage_env.get_l_events()
+    le.init_channel(app_id)
+    rng = np.random.default_rng(7)
+    events = []
+    for u in range(n_users):
+        for i in rng.choice(n_items, size=4, replace=False):
+            events.append(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                )
+            )
+    le.batch_insert(events, app_id=app_id)
+    return app_id
+
+
+def als_variant(tmp_path, iterations=6, interval=1):
+    variant = {
+        "id": "default",
+        "engineFactory": "predictionio_tpu.models.recommendation.engine.engine_factory",
+        "datasource": {"params": {"appName": "RateApp"}},
+        "algorithms": [
+            {
+                "name": "als",
+                "params": {
+                    "rank": 4,
+                    "numIterations": iterations,
+                    "lambda": 0.05,
+                    "seed": 3,
+                    "checkpointInterval": interval,
+                },
+            }
+        ],
+        "sparkConf": {"pio.mesh_shape": [1, 1]},
+    }
+    path = tmp_path / "engine.json"
+    path.write_text(json.dumps(variant))
+    return load_engine_variant(str(path))
+
+
+class CrashAfter:
+    """Patches CheckpointManager.save to simulate preemption after a step.
+
+    Manual patch/restore on purpose: monkeypatch.undo() would also undo the
+    storage_env fixture's env vars (same function-scoped instance).
+    """
+
+    def __init__(self, crash_step: int):
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+        self._cls = CheckpointManager
+        self._real_save = CheckpointManager.save
+        real_save = self._real_save
+
+        def crashing_save(mgr, step, state):
+            real_save(mgr, step, state)
+            mgr._manager.wait_until_finished()  # durable before we "die"
+            if step >= crash_step:
+                raise RuntimeError("simulated preemption")
+
+        CheckpointManager.save = crashing_save
+
+    def restore(self):
+        self._cls.save = self._real_save
+
+
+class TestResumeWorkflow:
+    def test_crash_then_resume_reuses_instance_and_matches(
+        self, storage_env, tmp_path, monkeypatch
+    ):
+        seed_ratings(storage_env)
+        variant = als_variant(tmp_path)
+
+        # uninterrupted reference model, trained from scratch
+        ref_instance = run_train(variant)
+        ref_blob = storage_env.get_model_data_models().get(ref_instance.id).models
+
+        # crash at iteration 2 (0-indexed) of 6
+        crasher = CrashAfter(crash_step=2)
+        try:
+            with pytest.raises(RuntimeError, match="preemption"):
+                run_train(variant)
+        finally:
+            crasher.restore()
+        instances = storage_env.get_meta_data_engine_instances()
+        crashed = instances.get_latest(
+            variant.variant_id, variant.engine_version, variant.path
+        )
+        assert crashed.status == STATUS_FAILED
+
+        # resume: same instance id, completes, skips finished iterations
+        from predictionio_tpu.parallel import als as als_mod
+
+        starts = []
+        real_fit = als_mod.als_fit
+
+        def spying_fit(*args, **kwargs):
+            starts.append(kwargs.get("start_iteration", 0))
+            return real_fit(*args, **kwargs)
+
+        monkeypatch.setattr(als_mod, "als_fit", spying_fit)
+        # the template module imported als_fit by name; patch there too
+        from predictionio_tpu.models.recommendation import engine as rec_engine
+
+        monkeypatch.setattr(rec_engine, "als_fit", spying_fit)
+
+        resumed = run_train(variant, WorkflowParams(resume=True))
+        assert resumed.id == crashed.id
+        assert resumed.status == STATUS_COMPLETED
+        assert starts == [3]  # iterations 0..2 were checkpointed; 3.. remain
+
+        # the resumed model must equal the uninterrupted one (ALS iteration
+        # depends only on the previous factors, which were checkpointed)
+        import pickle
+
+        def factors(blob):
+            kind, payload = pickle.loads(blob)[0]  # [(kind, pickled model)]
+            assert kind == "pickle"
+            return pickle.loads(payload).als.user_factors
+
+        np.testing.assert_allclose(
+            factors(ref_blob),
+            factors(storage_env.get_model_data_models().get(resumed.id).models),
+            rtol=1e-5,
+        )
+
+    def test_fresh_train_ignores_stale_checkpoints(
+        self, storage_env, tmp_path, monkeypatch
+    ):
+        seed_ratings(storage_env)
+        variant = als_variant(tmp_path)
+        crasher = CrashAfter(crash_step=2)
+        try:
+            with pytest.raises(RuntimeError):
+                run_train(variant)
+        finally:
+            crasher.restore()
+
+        from predictionio_tpu.models.recommendation import engine as rec_engine
+        from predictionio_tpu.parallel import als as als_mod
+
+        starts = []
+        real_fit = als_mod.als_fit
+
+        def spying_fit(*args, **kwargs):
+            starts.append(kwargs.get("start_iteration", 0))
+            return real_fit(*args, **kwargs)
+
+        monkeypatch.setattr(rec_engine, "als_fit", spying_fit)
+        fresh = run_train(variant)  # no resume flag
+        assert fresh.status == STATUS_COMPLETED
+        assert starts == [0]  # stale checkpoints wiped, not resumed
+        # and the crashed instance was NOT reused
+        crashed_still = [
+            i
+            for i in storage_env.get_meta_data_engine_instances().get_all()
+            if i.status == STATUS_FAILED
+        ]
+        assert len(crashed_still) == 1
+
+    def test_resume_with_changed_params_starts_fresh(
+        self, storage_env, tmp_path, monkeypatch
+    ):
+        seed_ratings(storage_env)
+        crasher = CrashAfter(crash_step=2)
+        try:
+            with pytest.raises(RuntimeError):
+                run_train(als_variant(tmp_path))
+        finally:
+            crasher.restore()
+        # different hyperparameters -> resume must refuse the old instance
+        variant2 = als_variant(tmp_path, iterations=4)
+        resumed = run_train(variant2, WorkflowParams(resume=True))
+        failed = [
+            i
+            for i in storage_env.get_meta_data_engine_instances().get_all()
+            if i.status == STATUS_FAILED
+        ]
+        assert resumed.status == STATUS_COMPLETED
+        assert len(failed) == 1
+        assert resumed.id != failed[0].id
+
+    def test_completed_train_clears_checkpoints(self, storage_env, tmp_path):
+        seed_ratings(storage_env)
+        variant = als_variant(tmp_path)
+        run_train(variant)
+        ckpt_root = os.path.join(os.environ["PIO_FS_BASEDIR"], "checkpoints")
+        leftovers = os.listdir(ckpt_root) if os.path.isdir(ckpt_root) else []
+        assert leftovers == []
+
+
+_KILL_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from predictionio_tpu.workflow.checkpoint import CheckpointManager
+real_save = CheckpointManager.save
+def dying_save(mgr, step, state):
+    real_save(mgr, step, state)
+    mgr._manager.wait_until_finished()
+    if step >= 2:
+        os._exit(9)  # hard kill: no FAILED status update, like a real preemption
+CheckpointManager.save = dying_save
+from predictionio_tpu.workflow.core_workflow import run_train
+from predictionio_tpu.workflow.json_extractor import load_engine_variant
+run_train(load_engine_variant(os.path.join({engine_dir!r}, "engine.json")))
+"""
+
+
+class TestKillAndRerunE2E:
+    def test_killed_process_resumes_via_cli(self, tmp_path):
+        """Process dies mid-train (os._exit: even the FAILED update never
+        lands, like a real preemption); `pio train --resume` in a NEW
+        process continues from the checkpoints and completes."""
+        env = dict(
+            os.environ,
+            PIO_FS_BASEDIR=str(tmp_path / "store"),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO + os.pathsep + os.path.dirname(os.path.abspath(__file__)),
+        )
+        env.pop("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", None)
+
+        # seed events through a subprocess so the sqlite file is shared
+        seed_code = (
+            "import numpy as np\n"
+            "from predictionio_tpu.data import DataMap, Event\n"
+            "from predictionio_tpu.data.storage.base import App\n"
+            "from predictionio_tpu.data import storage\n"
+            "app_id = storage.get_meta_data_apps().insert(App(name='RateApp'))\n"
+            "le = storage.get_l_events()\n"
+            "le.init_channel(app_id)\n"
+            "rng = np.random.default_rng(7)\n"
+            "evs = [Event(event='rate', entity_type='user', entity_id=f'u{u}',\n"
+            "             target_entity_type='item', target_entity_id=f'i{i}',\n"
+            "             properties=DataMap({'rating': float(rng.integers(1, 6))}))\n"
+            "       for u in range(12) for i in rng.choice(8, 4, replace=False)]\n"
+            "le.batch_insert(evs, app_id=app_id)\n"
+        )
+        subprocess.run(
+            [sys.executable, "-c", seed_code], env=env, check=True, timeout=120
+        )
+
+        engine_dir = tmp_path / "engine"
+        engine_dir.mkdir()
+        als_variant(engine_dir)
+
+        # run 1: dies with exit code 9 after checkpointing iteration 2
+        kill = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                _KILL_SCRIPT.format(repo=REPO, engine_dir=str(engine_dir)),
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert kill.returncode == 9, kill.stderr
+
+        # run 2: pio train --resume completes from the checkpoint
+        rerun = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "predictionio_tpu.tools.cli",
+                "train",
+                "--engine-dir",
+                str(engine_dir),
+                "--resume",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert rerun.returncode == 0, rerun.stderr
+        assert "Training completed" in rerun.stdout
+
+        # exactly one instance exists (reused), COMPLETED, with a model blob
+        check_code = (
+            "from predictionio_tpu.data import storage\n"
+            "insts = storage.get_meta_data_engine_instances().get_all()\n"
+            "assert len(insts) == 1, insts\n"
+            "assert insts[0].status == 'COMPLETED', insts[0].status\n"
+            "assert storage.get_model_data_models().get(insts[0].id) is not None\n"
+            "print('resume e2e ok')\n"
+        )
+        verify = subprocess.run(
+            [sys.executable, "-c", check_code],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert verify.returncode == 0, verify.stderr
+        assert "resume e2e ok" in verify.stdout
