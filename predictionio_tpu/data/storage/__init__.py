@@ -42,6 +42,8 @@ _BACKENDS: dict[str, str] = {
     "localfs": "predictionio_tpu.data.storage.localfs",
     "postgres": "predictionio_tpu.data.storage.postgres",
     "mysql": "predictionio_tpu.data.storage.mysql",
+    "elasticsearch": "predictionio_tpu.data.storage.elasticsearch",
+    "hbase": "predictionio_tpu.data.storage.hbase",
     # reference TYPE name for the scalikejdbc module; URL scheme picks
     # postgres vs mysql (postgres when absent)
     "jdbc": "predictionio_tpu.data.storage.jdbc",

@@ -1,0 +1,5 @@
+"""Elasticsearch storage backend (TYPE=elasticsearch)."""
+
+from predictionio_tpu.data.storage.elasticsearch.client import StorageClient
+
+__all__ = ["StorageClient"]

@@ -1,0 +1,5 @@
+"""HBase event-store backend (TYPE=hbase, events only)."""
+
+from predictionio_tpu.data.storage.hbase.client import StorageClient
+
+__all__ = ["StorageClient"]
