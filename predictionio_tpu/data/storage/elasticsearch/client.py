@@ -50,6 +50,7 @@ from predictionio_tpu.data.storage.base import (
     StorageClientConfig,
 )
 from predictionio_tpu.data.storage.elasticsearch.transport import (
+    ESError,
     FakeTransport,
     HttpTransport,
 )
@@ -57,12 +58,89 @@ from predictionio_tpu.data.storage.sql_common import ts_from_str, ts_ms, ts_to_s
 
 _SCAN_PAGE = 1000
 
+# -- explicit index mappings (reference ESUtils' not_analyzed mappings) ------
+#
+# Without these, a live ES dynamic-maps every string to analyzed text: term
+# queries on uppercase/spaced values (app names, entity ids) silently miss,
+# and sorting on event_id 400s. keyword for ids/names/entities, long for
+# *_ms, date for ISO8601 timestamps; large JSON blobs are stored-only
+# (text, index:false -- never queried, only read back from _source).
+
+_KW = {"type": "keyword"}
+_LONG = {"type": "long"}
+_TS = {"type": "date", "format": "strict_date_optional_time"}
+_BLOB = {"type": "text", "index": False}
+
+
+def mapping_for(kind: str) -> dict:
+    """ES mapping body for one index kind (``events_*`` share one shape)."""
+    if kind.startswith("events"):
+        props = {
+            "event_id": _KW,
+            "event": _KW,
+            "entity_type": _KW,
+            "entity_id": _KW,
+            "target_entity_type": _KW,
+            "target_entity_id": _KW,
+            "properties": _BLOB,
+            "event_time": _TS,
+            "event_time_ms": _LONG,
+            "pr_id": _KW,
+            "creation_time": _TS,
+        }
+    elif kind == "meta_apps":
+        props = {"id": _LONG, "name": _KW, "description": _BLOB}
+    elif kind == "meta_channels":
+        props = {"id": _LONG, "name": _KW, "app_id": _LONG}
+    elif kind == "meta_accesskeys":
+        props = {"key": _KW, "app_id": _LONG, "events": _KW}
+    elif kind == "meta_engine_instances":
+        props = {
+            "id": _KW,
+            "status": _KW,
+            "start_time": _TS,
+            "end_time": _TS,
+            "engine_id": _KW,
+            "engine_version": _KW,
+            "engine_variant": _KW,
+            "engine_factory": _KW,
+            "batch": _BLOB,
+            "env": _BLOB,
+            "runtime_conf": _BLOB,
+            "data_source_params": _BLOB,
+            "preparator_params": _BLOB,
+            "algorithms_params": _BLOB,
+            "serving_params": _BLOB,
+        }
+    elif kind == "meta_evaluation_instances":
+        props = {
+            "id": _KW,
+            "status": _KW,
+            "start_time": _TS,
+            "end_time": _TS,
+            "evaluation_class": _KW,
+            "engine_params_generator_class": _KW,
+            "batch": _BLOB,
+            "env": _BLOB,
+            "evaluator_results": _BLOB,
+            "evaluator_results_html": _BLOB,
+            "evaluator_results_json": _BLOB,
+        }
+    elif kind == "models":
+        props = {"id": _KW, "models": {"type": "binary"}}
+    elif kind == "sequences":
+        props = {"n": _LONG}
+    else:
+        raise KeyError(f"no ES mapping defined for index kind {kind!r}")
+    return {"properties": props}
+
 
 class StorageClient(base.BaseStorageClient):
     def __init__(self, config: StorageClientConfig, transport=None):
         super().__init__(config)
         props = config.properties
         self.prefix = props.get("INDEX", "pio")
+        self._ensured: set[str] = set()
         if transport is not None:
             self.transport = transport
         elif props.get("TRANSPORT", "").lower() == "fake":
@@ -81,8 +159,66 @@ class StorageClient(base.BaseStorageClient):
     def index_name(self, kind: str) -> str:
         return f"{self.prefix}_{kind}"
 
+    def ensure_index(self, kind: str) -> None:
+        """Create the index with its explicit mapping before first write.
+
+        Relying on ES dynamic mapping would analyze every string field:
+        term queries on uppercase/spaced values miss and event_id sorts
+        400. Races/pre-existing indices surface as 400
+        resource_already_exists, which is success here.
+        """
+        if kind in self._ensured:
+            return
+        if kind.startswith("events"):
+            # a cluster-side index template covers paths this per-process
+            # cache cannot: another process deletes an events index
+            # (app data-delete) and our next write auto-creates it --
+            # with the template, even auto-create carries the mappings
+            self._ensure_events_template()
+        try:
+            self.transport.request(
+                "PUT",
+                f"/{self.index_name(kind)}",
+                body={"mappings": mapping_for(kind)},
+            )
+        except ESError as exc:
+            error_type = ""
+            if isinstance(exc.body, dict):
+                error_type = (exc.body.get("error") or {}).get("type", "")
+            if exc.status != 400 or "exists" not in error_type:
+                raise
+        self._ensured.add(kind)
+
+    def _ensure_events_template(self) -> None:
+        if getattr(self, "_events_template_done", False):
+            return
+        name = f"{self.prefix}_events"
+        patterns = [f"{self.prefix}_events_*"]
+        try:
+            self.transport.request(
+                "PUT",
+                f"/_index_template/{name}",
+                body={
+                    "index_patterns": patterns,
+                    "template": {"mappings": mapping_for("events")},
+                },
+            )
+        except ESError:
+            # pre-7.8 clusters only know the legacy endpoint
+            self.transport.request(
+                "PUT",
+                f"/_template/{name}",
+                body={"index_patterns": patterns, "mappings": mapping_for("events")},
+            )
+        self._events_template_done = True
+
+    def drop_index(self, kind: str) -> None:
+        self.transport.request("DELETE", f"/{self.index_name(kind)}")
+        self._ensured.discard(kind)
+
     def next_id(self, sequence: str) -> int:
         """Atomic int sequence via ES doc versioning (reference ESSequences)."""
+        self.ensure_index("sequences")
         status, body = self.transport.request(
             "PUT",
             f"/{self.index_name('sequences')}/_doc/{sequence}",
@@ -92,6 +228,7 @@ class StorageClient(base.BaseStorageClient):
         return int(body["_version"])
 
     def put(self, kind: str, doc_id: str, source: dict) -> None:
+        self.ensure_index(kind)
         self.transport.request(
             "PUT",
             f"/{self.index_name(kind)}/_doc/{doc_id}",
@@ -457,15 +594,11 @@ class ESLEvents(base.LEvents):
         return f"events_{app_id}{suffix}"
 
     def init_channel(self, app_id: int, channel_id: int | None = None) -> bool:
-        self.c.transport.request(
-            "PUT", f"/{self.c.index_name(self._kind(app_id, channel_id))}"
-        )
+        self.c.ensure_index(self._kind(app_id, channel_id))
         return True
 
     def remove_channel(self, app_id: int, channel_id: int | None = None) -> bool:
-        self.c.transport.request(
-            "DELETE", f"/{self.c.index_name(self._kind(app_id, channel_id))}"
-        )
+        self.c.drop_index(self._kind(app_id, channel_id))
         return True
 
     @staticmethod

@@ -88,6 +88,10 @@ class FakeTransport:
     def __init__(self):
         # index -> doc_id -> {"_source": dict, "_version": int}
         self.indices: dict[str, dict[str, dict]] = {}
+        # index -> the explicit mapping body it was created with
+        self.mappings: dict[str, dict] = {}
+        # template name -> {"index_patterns": [...], "template": {...}}
+        self.index_templates: dict[str, dict] = {}
         self._lock = threading.RLock()
 
     # -- endpoint router -----------------------------------------------------
@@ -110,6 +114,9 @@ class FakeTransport:
                 return self._delete_by_query("/".join(parts[:-1]), body or {})
             if parts[-1] == "_bulk":
                 raise NotImplementedError("fake ES: _bulk not modeled")
+            if len(parts) == 2 and parts[0] == "_index_template" and method == "PUT":
+                self.index_templates[parts[1]] = dict(body or {})
+                return 200, {"acknowledged": True}
             if len(parts) == 3 and parts[1] == "_doc":
                 index, doc_id = parts[0], parts[2]
                 if method in ("PUT", "POST"):
@@ -121,10 +128,19 @@ class FakeTransport:
             if len(parts) == 4 and parts[1] == "_update":
                 raise NotImplementedError("fake ES: _update not modeled")
             if len(parts) == 1 and method == "PUT":  # create index
-                self.indices.setdefault(parts[0], {})
+                if parts[0] in self.indices:
+                    # real ES 400s on re-create; the DAO ensure_index path
+                    # treats that as success, so model it faithfully
+                    raise ESError(
+                        400,
+                        {"error": {"type": "resource_already_exists_exception"}},
+                    )
+                self.indices[parts[0]] = {}
+                self.mappings[parts[0]] = (body or {}).get("mappings", {})
                 return 200, {"acknowledged": True}
             if len(parts) == 1 and method == "DELETE":
                 self.indices.pop(parts[0], None)
+                self.mappings.pop(parts[0], None)
                 return 200, {"acknowledged": True}
             if len(parts) == 1 and method == "HEAD":
                 return (200 if parts[0] in self.indices else 404), {}
@@ -132,7 +148,16 @@ class FakeTransport:
 
     # -- document ops --------------------------------------------------------
     def _index_doc(self, index: str, doc_id: str, body: dict) -> tuple[int, dict]:
-        docs = self.indices.setdefault(index, {})
+        if index not in self.indices:
+            # real ES would auto-create with DYNAMIC mappings here -- the
+            # exact failure mode the explicit-mapping contract exists to
+            # prevent (analyzed term queries, unsortable ids). Fail loudly
+            # so a DAO write path that skipped ensure_index is caught in CI.
+            raise NotImplementedError(
+                f"fake ES: write to index {index!r} before explicit creation"
+                " -- DAO must ensure_index (explicit mappings) first"
+            )
+        docs = self.indices[index]
         existing = docs.get(doc_id)
         version = (existing["_version"] + 1) if existing else 1
         docs[doc_id] = {"_source": dict(body or {}), "_version": version}

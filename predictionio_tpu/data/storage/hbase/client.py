@@ -46,7 +46,6 @@ from predictionio_tpu.data.storage.sql_common import ts_ms
 
 N_SHARDS = 8
 _FAMILY = "e"
-_MAX_TIME_MS = 10 ** 13 - 1
 
 
 class StorageClient(base.BaseStorageClient):
@@ -180,7 +179,6 @@ class HBLEvents(base.LEvents):
     ) -> Iterator[Event]:
         table = self.table(app_id, channel_id)
         start_ms = ts_ms(start_time) if start_time is not None else 0
-        until_ms = ts_ms(until_time) if until_time is not None else _MAX_TIME_MS + 1
 
         # one prefix scan per shard; entity filters narrow to ONE shard
         # (the rowkey's shard is a pure function of the entity)
@@ -191,7 +189,15 @@ class HBLEvents(base.LEvents):
 
         def shard_stream(shard: int):
             start_row = f"{shard:02d}{start_ms:013d}"
-            end_row = f"{shard:02d}{until_ms:013d}"
+            if until_time is not None:
+                # exclusive end row: keys at exactly until_ms carry a suffix
+                # and sort after this, so untilTime stays exclusive
+                end_row = f"{shard:02d}{ts_ms(until_time):013d}"
+            else:
+                # unbounded: the next shard's prefix. A formatted
+                # _MAX_TIME_MS+1 here is 14 digits, which sorts BEFORE the
+                # 13-digit zero-padded times and made unbounded scans empty
+                end_row = f"{shard + 1:02d}"
             for rowkey, cells in self.c.transport.scan(
                 table, start_row=start_row, end_row=end_row
             ):

@@ -113,9 +113,12 @@ _SCHEMA_STATEMENTS = [
       ON events (app_id, channel_id, event, event_time_ms)""",
 ]
 
-# `key` is reserved in MySQL; the shared DAO SQL uses it bare as the
-# access_keys column. \b keeps access_keys/keys intact.
+# `key` is reserved in MySQL; the shared DAO SQL uses it bare ONLY as the
+# access_keys column. \b keeps access_keys/keys intact; the rewrite is
+# scoped to access_keys statements and skips single-quoted string literals,
+# so a statement carrying 'key' as data is never mangled.
 _KEY_TOKEN = re.compile(r"\bkey\b")
+_SQUOTE_LITERAL = re.compile(r"('(?:[^']|'')*')")
 
 
 def parse_connection_properties(props: dict[str, str]) -> dict:
@@ -189,7 +192,13 @@ class StorageClient(sql_common.SQLStorageClient):
             self._conn.commit()
 
     def sql(self, statement: str) -> str:
-        statement = _KEY_TOKEN.sub("`key`", statement)
+        if "access_keys" in statement:
+            statement = "".join(
+                part
+                if part.startswith("'")
+                else _KEY_TOKEN.sub("`key`", part)
+                for part in _SQUOTE_LITERAL.split(statement)
+            )
         return statement.replace("?", self.placeholder)
 
     def execute(self, sql: str, params: tuple = ()):

@@ -275,7 +275,10 @@ class SQLAccessKeys(base.AccessKeys):
         return AccessKey(key=rows[0][0], app_id=rows[0][1], events=json.loads(rows[0][2]))
 
     def get_all(self) -> list[AccessKey]:
-        rows = self.c.query("SELECT key, app_id, events FROM access_keys")
+        # must go through sql(): `key` is reserved on MySQL
+        rows = self.c.query(
+            self.c.sql("SELECT key, app_id, events FROM access_keys")
+        )
         return [AccessKey(key=r[0], app_id=r[1], events=json.loads(r[2])) for r in rows]
 
     def get_by_app_id(self, app_id: int) -> list[AccessKey]:

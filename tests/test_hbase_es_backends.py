@@ -57,6 +57,41 @@ class TestESSpecifics:
         ids = [apps.insert(App(name=f"A{i}")) for i in range(3)]
         assert ids == sorted(ids) and len(set(ids)) == 3
 
+    def test_indices_created_with_explicit_mappings(self, storage_env):
+        """Every index must carry explicit mappings at create time (keyword
+        ids/names, long *_ms, date timestamps) -- dynamic text mapping on a
+        live ES breaks term queries on uppercase/spaced values and 400s
+        event_id sorts. The fake transport refuses writes to indices it
+        never saw created, so this also proves no DAO path skips
+        ensure_index."""
+        if storage_env._registry._repo_source("EVENTDATA") != "ES":
+            pytest.skip("ES-only check")
+        apps = storage_env.get_meta_data_apps()
+        apps.insert(App(name="My App 1"))
+        assert apps.get_by_name("My App 1") is not None
+        le = storage_env.get_l_events()
+        le.init_channel(7)
+        le.insert(mk_event(0), app_id=7)
+        mappings = storage_env._registry.client_for_source("ES").transport.mappings
+        app_props = mappings["pio_meta_apps"]["properties"]
+        assert app_props["name"] == {"type": "keyword"}
+        assert app_props["id"] == {"type": "long"}
+        ev_props = mappings["pio_events_7"]["properties"]
+        assert ev_props["entity_id"]["type"] == "keyword"
+        assert ev_props["event"]["type"] == "keyword"
+        assert ev_props["event_time_ms"]["type"] == "long"
+        assert ev_props["event_time"]["type"] == "date"
+        assert ev_props["properties"]["index"] is False
+        assert mappings["pio_sequences"]["properties"]["n"]["type"] == "long"
+        # cluster-side template: even an auto-created events index (another
+        # process deleted it; our per-process ensure cache is stale) gets
+        # the explicit mappings
+        transport = storage_env._registry.client_for_source("ES").transport
+        template = transport.index_templates["pio_events"]
+        assert template["index_patterns"] == ["pio_events_*"]
+        t_props = template["template"]["mappings"]["properties"]
+        assert t_props["entity_id"]["type"] == "keyword"
+
     def test_scan_paginates_past_page_size(self, storage_env):
         """find() must stream beyond one search page (search_after path)."""
         import predictionio_tpu.data.storage.elasticsearch.client as es_client

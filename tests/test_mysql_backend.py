@@ -72,6 +72,21 @@ class TestDialect:
         assert "access_keys" in self.sql("DELETE FROM access_keys WHERE key=?")
         assert "`access_keys`" not in self.sql("DELETE FROM access_keys WHERE key=?")
 
+    def test_key_rewrite_scoped_to_access_keys_statements(self):
+        # a non-access_keys statement with a bare `key` word stays intact
+        stmt = "SELECT properties FROM events WHERE entity_id = 'key'"
+        assert self.sql(stmt) == stmt
+        # ... as does 'key' inside a string literal of an access_keys stmt
+        assert (
+            self.sql("SELECT key FROM access_keys WHERE key = 'key'")
+            == "SELECT `key` FROM access_keys WHERE `key` = 'key'"
+        )
+        # escaped-quote literals stay protected
+        assert (
+            self.sql("SELECT key FROM access_keys WHERE app_id = 'a''key'''")
+            == "SELECT `key` FROM access_keys WHERE app_id = 'a''key'''"
+        )
+
     def test_conflict_sql_is_mysql_flavored(self):
         assert StorageClient.INSERT_IGNORE_EVENT_CHANNELS.startswith("INSERT IGNORE")
         assert "ON DUPLICATE KEY UPDATE" in StorageClient.UPSERT_MODEL
