@@ -12,21 +12,51 @@ zero-egress container): 138k users x 27k items x 20M implicit-ish ratings
 with zipf item popularity, per-user history capped at 256 (padded-CSR
 truncation, the ALX-style layout choice).
 
-Prints ONE JSON line and writes a ``BENCH_evidence.json`` sidecar (device
-kind, per-run timings, an MFU estimate). Env knobs: PIO_BENCH_SCALE (edge
-count divisor for smoke runs), PIO_BENCH_PLATFORM=cpu to skip the TPU,
-PIO_BENCH_PROBE_BUDGET_S (total TPU probe budget, default 300).
+Deadline-safe orchestration (round-3 lesson: the driver run timed out with
+NO metric at all, rc=124). The parent process imports no JAX and therefore
+cannot hang on a wedged TPU tunnel; every measurement runs in a child
+subprocess with a hard timeout, writing its result to a file the parent
+collects. Phases, cheapest first, each gated on the remaining deadline:
+
+  1. scaled CPU ALS (1/20 scale by default) -- a valid provisional number
+     within ~1-2 minutes under any conditions;
+  2. TPU probe (single attempt, <=120s -- escalating retries were shown in
+     rounds 1-2 to buy nothing on a wedged tunnel);
+  3. full-scale run on the TPU if the probe passed, else on CPU if time
+     remains.
+
+The parent prints exactly ONE metric JSON line: at completion, at the
+internal deadline, or from its SIGTERM handler if the driver's ``timeout``
+fires first. Successful TPU measurements append to ``BENCH_history.json``
+so later wedged rounds can still report the last known TPU number + date.
+
+Env knobs: PIO_BENCH_DEADLINE_S (parent deadline, default 480),
+PIO_BENCH_PROBE_BUDGET_S (TPU probe timeout, default 120, capped at 120),
+PIO_BENCH_SCALE (edge-count divisor for the full-scale phase, default 1),
+PIO_BENCH_PLATFORM=cpu (skip the TPU probe entirely).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
+import signal
+import subprocess
 import sys
+import tempfile
 import time
 
-EVIDENCE: dict = {"probes": [], "runs": {}}
+REPO = os.path.dirname(os.path.abspath(__file__))
+N_USERS_FULL, N_ITEMS_FULL, N_EDGES_FULL = 138_000, 27_000, 20_000_000
+RANK = 16
 
+EVIDENCE: dict = {"probes": [], "runs": {}, "phases": []}
+
+
+# --------------------------------------------------------------------------
+# measurement code (runs in CHILD processes only)
+# --------------------------------------------------------------------------
 
 def make_dataset(n_edges: int, n_users: int, n_items: int, seed: int = 0):
     import numpy as np
@@ -117,12 +147,163 @@ def run_als(platform: str, data, config, iters_to_time: int) -> float:
     return per_iter
 
 
-def _probe_tpu_once(timeout_s: int) -> tuple[str | None, str]:
-    """Check TPU reachability in a SUBPROCESS: a wedged axon tunnel blocks
-    backend init indefinitely in-process, which would hang the whole bench.
-    Returns (platform or None, diagnostic)."""
-    import subprocess
+def _half_step_flops(rows: int, pad_len: float, rank: int) -> float:
+    """One half-step over R rows of padded length L with K=rank:
+    Gram einsum rlk,rlj->rkj = 2*R*L*K^2; rhs = 2*R*L*K; batched Cholesky
+    solve ~ R*(K^3/3 + 2K^2). Padding rows count: the device computes them.
+    """
+    k = float(rank)
+    return (
+        2 * rows * pad_len * k * k       # gram
+        + 2 * rows * pad_len * k         # rhs
+        + rows * (k ** 3 / 3 + 2 * k * k)  # solve
+    )
 
+
+def als_flops_per_iteration(data, rank: int) -> float:
+    """FLOPs of one full ALS iteration (both half-steps) on the padded data."""
+    return sum(
+        _half_step_flops(*csr.indices.shape, rank)
+        for csr in (data.by_row, data.by_col)
+    )
+
+
+def full_scale_flops_estimate(scale: float) -> float:
+    """Analytic FLOPs/iteration at ``scale`` reduction of ML-20M.
+
+    At full scale the 256-cap saturates both orientations (avg user history
+    145, zipf item popularity), so pad_len = max_len on both sides; rows
+    round up to the lane multiple of 8. Used to scale a small-run
+    measurement up to the metric's nominal scale (flagged as an estimate
+    in the printed note).
+    """
+    n_users = int(N_USERS_FULL / max(scale ** 0.5, 1))
+    n_items = int(N_ITEMS_FULL / max(scale ** 0.5, 1))
+
+    def side(rows: int) -> float:
+        return _half_step_flops(math.ceil(rows / 8) * 8, 256.0, RANK)
+
+    return side(n_users) + side(n_items)
+
+
+def child_main(mode: str, result_path: str) -> None:
+    """Measurement child: builds the dataset, times ALS, writes one JSON file.
+
+    ``mode`` is cpu or tpu; the parent sets JAX_PLATFORMS=cpu in the env for
+    cpu children so a wedged TPU backend is never initialised here, and
+    PIO_BENCH_CHILD_SCALE carries the edge-count divisor.
+    """
+    t0 = time.time()
+    scale = float(os.environ.get("PIO_BENCH_CHILD_SCALE", "1"))
+
+    if mode != "tpu":
+        # JAX_PLATFORMS=cpu in the env is NOT enough: the axon site hook
+        # force-sets jax_platforms="axon,cpu" at registration (see
+        # tests/conftest.py), and building the axon client can block on the
+        # tunnel. Override at the config level before any backend init.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from predictionio_tpu.parallel.als import ALSConfig, build_als_data
+
+    n_users = int(N_USERS_FULL / max(scale ** 0.5, 1))
+    n_items = int(N_ITEMS_FULL / max(scale ** 0.5, 1))
+    n_edges = int(N_EDGES_FULL / scale)
+    users, items, ratings = make_dataset(n_edges, n_users, n_items)
+    config = ALSConfig(rank=RANK, reg=0.05, max_len=256)
+    data = build_als_data(users, items, ratings, n_users, n_items, config)
+
+    # the probed accelerator need not be literally named "tpu" (the axon
+    # tunnel backend registers platform "axon"); the parent forwards the
+    # probe's actual platform name
+    if mode == "tpu":
+        platform = os.environ.get("PIO_BENCH_TPU_PLATFORM", "tpu")
+    else:
+        platform = "cpu"
+    sec = run_als(platform, data, config, 5 if mode == "tpu" else 2)
+    out = {
+        "mode": mode,
+        "scale": scale,
+        "edges": n_edges,
+        "sec_per_iter": sec,
+        "flops_per_iter": als_flops_per_iteration(data, config.rank),
+        "run_record": EVIDENCE["runs"].get(platform),
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    tmp = result_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f)
+    os.replace(tmp, result_path)
+
+
+# --------------------------------------------------------------------------
+# orchestration (PARENT process -- stdlib only, must never hang)
+# --------------------------------------------------------------------------
+
+_CURRENT_CHILD: subprocess.Popen | None = None
+
+
+def _run_child(
+    mode: str,
+    scale: float,
+    timeout_s: float,
+    phase: str,
+    tpu_platform: str | None = None,
+) -> dict | None:
+    """Spawn ``bench.py --child`` and collect its result file (or None)."""
+    global _CURRENT_CHILD
+    result_path = os.path.join(
+        tempfile.gettempdir(), f"pio_bench_{os.getpid()}_{phase}.json"
+    )
+    env = dict(os.environ)
+    env["PIO_BENCH_CHILD_SCALE"] = str(scale)
+    if mode == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        env.pop("JAX_PLATFORMS", None)
+        if tpu_platform:
+            env["PIO_BENCH_TPU_PLATFORM"] = tpu_platform
+    t0 = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", mode, result_path],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    _CURRENT_CHILD = proc
+    phase_rec = {"phase": phase, "mode": mode, "scale": scale,
+                 "timeout_s": round(timeout_s, 1)}
+    try:
+        _, err = proc.communicate(timeout=timeout_s)
+        phase_rec["rc"] = proc.returncode
+        if proc.returncode != 0:
+            phase_rec["stderr_tail"] = (err or "")[-500:]
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        phase_rec["rc"] = "timeout"
+    finally:
+        _CURRENT_CHILD = None
+        phase_rec["elapsed_s"] = round(time.time() - t0, 1)
+        EVIDENCE["phases"].append(phase_rec)
+    try:
+        with open(result_path) as f:
+            result = json.load(f)
+        os.unlink(result_path)
+        EVIDENCE["runs"][phase] = result.get("run_record")
+        return result
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _probe_tpu(timeout_s: float) -> str | None:
+    """Single-attempt TPU reachability probe in a subprocess.
+
+    Rounds 1-2 showed escalating retries (60/120/240s) all hang the same
+    way on a wedged axon tunnel; one bounded attempt is all a probe buys.
+    """
     code = (
         "import jax\n"
         "ds = jax.devices()\n"
@@ -130,6 +311,7 @@ def _probe_tpu_once(timeout_s: int) -> tuple[str | None, str]:
         "x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()\n"
         "print('PLATFORM=' + ds[0].platform)\n"
     )
+    t0 = time.time()
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
@@ -137,98 +319,174 @@ def _probe_tpu_once(timeout_s: int) -> tuple[str | None, str]:
             text=True,
             timeout=timeout_s,
         )
+        if proc.returncode != 0:
+            diag = f"exit {proc.returncode}; stderr tail: {proc.stderr[-500:]!r}"
+            platform = None
+        else:
+            platform = ""
+            for line in proc.stdout.strip().splitlines():
+                if line.startswith("PLATFORM="):
+                    platform = line[len("PLATFORM="):]
+            if platform and platform != "cpu":
+                diag = f"ok ({platform})"
+            else:
+                diag = f"backend resolved to {platform or 'nothing'!r} (not an accelerator)"
+                platform = None
     except subprocess.TimeoutExpired as exc:
         tail = ((exc.stderr or b"").decode("utf-8", "replace"))[-500:]
-        return None, f"timeout after {timeout_s}s; stderr tail: {tail!r}"
-    if proc.returncode != 0:
-        return None, f"exit {proc.returncode}; stderr tail: {proc.stderr[-500:]!r}"
-    platform = ""
-    for line in proc.stdout.strip().splitlines():
-        if line.startswith("PLATFORM="):
-            platform = line[len("PLATFORM="):]
-    if platform and platform != "cpu":
-        return platform, f"ok ({platform})"
-    return None, f"backend resolved to {platform or 'nothing'!r} (not an accelerator)"
+        diag = f"timeout after {int(timeout_s)}s; stderr tail: {tail!r}"
+        platform = None
+    EVIDENCE["probes"].append(
+        {"timeout_s": int(timeout_s), "elapsed_s": round(time.time() - t0, 1),
+         "result": diag}
+    )
+    return platform
 
 
-def probe_tpu(total_budget_s: int) -> str | None:
-    """Escalating-timeout probes (60/120/240...s) until the budget is spent.
+def _load_history() -> list:
+    try:
+        with open(os.path.join(REPO, "BENCH_history.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return []
 
-    Round 1 failed here: two fixed 120s probes timed out in the driver
-    environment and the bench silently fell back to CPU, leaving the
-    round's primary metric unproven. Every attempt's diagnostic is kept in
-    the evidence sidecar so a fallback is at least explained.
-    """
-    spent = 0.0
-    timeout = 60
-    attempt = 0
-    while spent < total_budget_s:
-        attempt += 1
-        budgeted = min(timeout, max(30, total_budget_s - spent))
-        t0 = time.perf_counter()
-        platform, diag = _probe_tpu_once(int(budgeted))
-        elapsed = time.perf_counter() - t0
-        spent += elapsed
-        EVIDENCE["probes"].append(
-            {
-                "attempt": attempt,
-                "timeout_s": int(budgeted),
-                "elapsed_s": round(elapsed, 1),
-                "result": diag,
-            }
+
+def _append_history(entry: dict) -> None:
+    # atomic + swallowed: a mid-write SIGTERM (os._exit in the handler) or a
+    # read-only checkout must corrupt/lose only the history, never the run
+    try:
+        history = _load_history()
+        history.append(entry)
+        path = os.path.join(REPO, "BENCH_history.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(history, f, indent=1)
+        os.replace(path + ".tmp", path)
+    except OSError:
+        pass
+
+
+class _Bench:
+    """Best-result-so-far state; printable at any moment (SIGTERM-safe)."""
+
+    def __init__(self) -> None:
+        self.deadline = time.time() + float(
+            os.environ.get("PIO_BENCH_DEADLINE_S", "480")
         )
-        if platform:
-            return platform
-        timeout *= 2
-        time.sleep(min(10, max(0, total_budget_s - spent)))
-        spent += 10
-    return None
+        self.result: dict | None = None   # what the single JSON line reports
+        self.edges = 0
+        self.printed = False
 
+    def remaining(self) -> float:
+        return self.deadline - time.time()
 
-def als_flops_per_iteration(data, rank: int) -> float:
-    """FLOPs of one full ALS iteration (both half-steps) on the padded data.
-
-    Per half-step over R rows of padded length L with K=rank:
-    Gram einsum rlk,rlj->rkj = 2*R*L*K^2; rhs = 2*R*L*K; batched Cholesky
-    solve ~ R*(K^3/3 + 2K^2). Padding rows count: the device computes them.
-    """
-    total = 0.0
-    for csr in (data.by_row, data.by_col):
-        rows, pad_len = csr.indices.shape
-        k = float(rank)
-        total += 2 * rows * pad_len * k * k      # gram
-        total += 2 * rows * pad_len * k          # rhs
-        total += rows * (k ** 3 / 3 + 2 * k * k)  # solve
-    return total
+    def emit(self) -> None:
+        if self.printed:
+            return
+        self.printed = True
+        result = self.result or {
+            "value": 0.0,
+            "vs_baseline": 0.0,
+            "note": "no measurement completed before the deadline",
+        }
+        try:
+            with open(os.path.join(REPO, "BENCH_evidence.json"), "w") as f:
+                json.dump(EVIDENCE, f, indent=1)
+        except OSError:
+            pass
+        print(
+            json.dumps(
+                {
+                    "metric": "als_iters_per_sec_per_chip_ml20m_scale",
+                    "value": result["value"],
+                    "unit": "iters/sec",
+                    "vs_baseline": result["vs_baseline"],
+                    "note": result["note"],
+                    "edges": self.edges or N_EDGES_FULL,
+                }
+            ),
+            flush=True,
+        )
 
 
 def main() -> None:
+    bench = _Bench()
+
+    def on_term(signum, frame):
+        if _CURRENT_CHILD is not None:
+            try:
+                _CURRENT_CHILD.kill()
+            except OSError:
+                pass
+        EVIDENCE["terminated_by_signal"] = signum
+        bench.emit()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    try:
+        _run_phases(bench)
+    except Exception as exc:
+        # any orchestrator bug (or an OSError writing a sidecar) must still
+        # print the metric line for whatever was measured before it
+        EVIDENCE["orchestrator_error"] = repr(exc)
+    finally:
+        bench.emit()
+
+
+def _run_phases(bench: _Bench) -> None:
     want_tpu = os.environ.get("PIO_BENCH_PLATFORM", "tpu") != "cpu"
-    budget = int(os.environ.get("PIO_BENCH_PROBE_BUDGET_S", "300"))
-    tpu_platform = probe_tpu(budget) if want_tpu else None
+    full_scale = float(os.environ.get("PIO_BENCH_SCALE", "1"))
+    small_scale = max(20.0, full_scale)
 
-    import jax
+    # Phase 1: scaled CPU measurement -- a provisional number fast.
+    small = _run_child(
+        "cpu", small_scale, min(240.0, max(60.0, bench.remaining() * 0.45)),
+        phase="cpu_small",
+    )
+    cpu_full_sec_est = None
+    if small:
+        bench.edges = int(N_EDGES_FULL / full_scale)
+        if small_scale == full_scale:
+            # phase 1 already measured the requested scale: report it
+            # directly -- the flops-ratio extrapolation only applies when
+            # projecting a smaller run up to a larger target
+            cpu_full_sec_est = small["sec_per_iter"]
+            note = f"cpu only (measured at PIO_BENCH_SCALE={full_scale:g})"
+        else:
+            ratio = full_scale_flops_estimate(full_scale) / small["flops_per_iter"]
+            cpu_full_sec_est = small["sec_per_iter"] * ratio
+            note = (
+                f"cpu only, scaled estimate from 1/{small_scale:g}-scale run"
+                f" ({small['sec_per_iter']:.3f} s/iter small, flops ratio"
+                f" {ratio:.1f}x)"
+            )
+        bench.result = {
+            "value": round(1.0 / cpu_full_sec_est, 4),
+            "vs_baseline": 1.0,
+            "note": note,
+        }
 
-    if tpu_platform is None:
-        # keep the wedged/absent TPU backend out of every later devices() call
-        jax.config.update("jax_platforms", "cpu")
+    # Phase 2: TPU probe (single bounded attempt).
+    tpu_platform = None
+    if want_tpu and bench.remaining() > 90:
+        probe_budget = min(
+            120.0,
+            float(os.environ.get("PIO_BENCH_PROBE_BUDGET_S", "120")),
+            bench.remaining() - 60,
+        )
+        tpu_platform = _probe_tpu(probe_budget)
 
-    from predictionio_tpu.parallel.als import ALSConfig, build_als_data
-
-    scale = float(os.environ.get("PIO_BENCH_SCALE", "1"))
-    n_users, n_items = int(138_000 / max(scale ** 0.5, 1)), int(27_000 / max(scale ** 0.5, 1))
-    n_edges = int(20_000_000 / scale)
-    users, items, ratings = make_dataset(n_edges, n_users, n_items)
-
-    config = ALSConfig(rank=16, reg=0.05, max_len=256)
-    data = build_als_data(users, items, ratings, n_users, n_items, config)
-
-    def attempt() -> dict:
-        cpu_secs = run_als("cpu", data, config, 2)
-        if tpu_platform:
-            tpu_secs = run_als(tpu_platform, data, config, 5)
-            flops = als_flops_per_iteration(data, config.rank)
-            achieved = flops / tpu_secs
+    # Phase 3: full-scale measurement on the best available platform.
+    if tpu_platform and bench.remaining() > 60:
+        full = _run_child(
+            "tpu", full_scale, bench.remaining() - 30, phase="tpu_full",
+            tpu_platform=tpu_platform,
+        )
+        if full:
+            tpu_sec = full["sec_per_iter"]
+            flops = full["flops_per_iter"]
+            achieved = flops / tpu_sec
             # v5e-1 peak: ~197 TFLOP/s bf16 (f32 accumulation); the solver
             # runs f32 Grams, so this MFU is a conservative lower bound
             EVIDENCE["mfu"] = {
@@ -237,52 +495,64 @@ def main() -> None:
                 "peak_bf16_flops_per_s": 197e12,
                 "mfu_vs_bf16_peak": round(achieved / 197e12, 4),
             }
-            return {
-                "value": round(1.0 / tpu_secs, 4),
-                "vs_baseline": round(cpu_secs / tpu_secs, 3),
+            vs = (cpu_full_sec_est / tpu_sec) if cpu_full_sec_est else 0.0
+            bench.edges = full["edges"]
+            bench.result = {
+                "value": round(1.0 / tpu_sec, 4),
+                "vs_baseline": round(vs, 3),
                 "note": (
                     f"tpu({tpu_platform}) vs host-cpu baseline"
-                    f" {1.0 / cpu_secs:.3f} it/s;"
+                    f" {1.0 / cpu_full_sec_est:.3f} it/s (cpu scaled-estimate);"
+                    f" mfu~{EVIDENCE['mfu']['mfu_vs_bf16_peak']:.1%} of bf16 peak"
+                    if cpu_full_sec_est
+                    else f"tpu({tpu_platform}); no cpu baseline this run;"
                     f" mfu~{EVIDENCE['mfu']['mfu_vs_bf16_peak']:.1%} of bf16 peak"
                 ),
             }
-        if not want_tpu:
-            note = "cpu only (PIO_BENCH_PLATFORM=cpu)"
-        else:
-            probe_tail = "; ".join(p["result"] for p in EVIDENCE["probes"][-2:])
-            note = f"cpu only (no TPU backend reachable: {probe_tail})"[:400]
-        return {
-            "value": round(1.0 / cpu_secs, 4),
-            "vs_baseline": 1.0,
-            "note": note,
-        }
-
-    try:
-        try:
-            result = attempt()
-        except Exception as exc:  # one full retry before giving up
-            EVIDENCE["first_attempt_error"] = repr(exc)
-            result = attempt()
-    finally:
-        # evidence must land even when both attempts fail -- a stale sidecar
-        # from an earlier run would misattribute its numbers to this one
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_evidence.json"), "w") as f:
-            json.dump(EVIDENCE, f, indent=1)
-
-    print(
-        json.dumps(
-            {
-                "metric": "als_iters_per_sec_per_chip_ml20m_scale",
-                "value": result["value"],
-                "unit": "iters/sec",
-                "vs_baseline": result["vs_baseline"],
-                "note": result["note"],
-                "edges": n_edges,
+            _append_history(
+                {
+                    "date": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
+                    "platform": tpu_platform,
+                    "value_iters_per_sec": bench.result["value"],
+                    "vs_baseline": bench.result["vs_baseline"],
+                    "mfu_vs_bf16_peak": EVIDENCE["mfu"]["mfu_vs_bf16_peak"],
+                    "edges": bench.edges,
+                }
+            )
+    elif bench.remaining() > 240 and not (small and small_scale == full_scale):
+        # no TPU: upgrade the provisional scaled number to a measured
+        # full-scale CPU run if the deadline allows (pointless when the
+        # "small" phase already measured this exact scale)
+        full = _run_child("cpu", full_scale, bench.remaining() - 30, phase="cpu_full")
+        if full:
+            bench.edges = full["edges"]
+            history = _load_history()
+            last_tpu = history[-1] if history else None
+            probe_tail = "; ".join(p["result"] for p in EVIDENCE["probes"][-1:])
+            note = (
+                f"cpu only (no TPU backend reachable: {probe_tail})"
+                if want_tpu
+                else "cpu only (PIO_BENCH_PLATFORM=cpu)"
+            )
+            if last_tpu:
+                note += (
+                    f"; last known TPU: {last_tpu['value_iters_per_sec']} it/s"
+                    f" on {last_tpu['date']}"
+                )
+            bench.result = {
+                "value": round(1.0 / full["sec_per_iter"], 4),
+                "vs_baseline": 1.0,
+                "note": note[:400],
             }
-        )
-    )
+
+    if bench.result and not tpu_platform:
+        history = _load_history()
+        if history:
+            EVIDENCE["last_known_tpu"] = history[-1]
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        child_main(sys.argv[2], sys.argv[3])
+    else:
+        sys.exit(main())

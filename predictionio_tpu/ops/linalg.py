@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax.lax.linalg import cholesky
 from jax.scipy.linalg import cho_solve
@@ -11,22 +12,37 @@ from jax.scipy.linalg import cho_solve
 _UNROLL_MAX_K = 32
 
 
-def batched_spd_solve(gram: jnp.ndarray, rhs: jnp.ndarray, jitter: float = 1e-6):
+def batched_spd_solve(
+    gram: jnp.ndarray,
+    rhs: jnp.ndarray,
+    jitter: float = 1e-6,
+    unroll: bool | None = None,
+):
     """Solve ``gram[b] @ x[b] = rhs[b]`` for a batch of SPD systems.
 
-    For the small K x K normal-equation systems ALS produces (K = rank,
-    typically 8-64) the decomposition is hand-unrolled over K with every
-    step an elementwise op across the batch: on TPU this runs on the VPU at
-    full lane width instead of dispatching per-row serial Cholesky kernels
-    (measured ~5x faster than ``lax.linalg.cholesky`` + ``cho_solve`` at
-    138k x 16 x 16 on v5e, and it is no slower on CPU). A small jitter
-    guards rows whose Gram is singular (entities with no interactions);
-    their solution is ~0 because their rhs is 0.
+    Two solve paths, chosen per platform. On TPU, the small K x K
+    normal-equation systems ALS produces (K = rank, typically 8-64) are
+    hand-unrolled over K with every step an elementwise op across the
+    batch, so the batch dim rides the VPU lanes (measured ~5x faster than
+    ``lax.linalg.cholesky`` + ``cho_solve`` at 138k x 16 x 16 on v5e). On
+    CPU the same unrolled graph is ~8x SLOWER than LAPACK's batched
+    Cholesky (round-2 driver evidence: 0.42 -> 0.05 it/s at ML-20M scale),
+    so the lax path is the default there. ``unroll=None`` decides from
+    ``jax.default_backend()``; callers that compile for an explicit mesh
+    (e.g. ``parallel.als``) should pass the mesh platform instead, since
+    the default backend need not match the target devices.
+
+    A small jitter guards rows whose Gram is singular (entities with no
+    interactions); their solution is ~0 because their rhs is 0.
     """
     k = gram.shape[-1]
     eye = jnp.eye(k, dtype=gram.dtype)
     gram = gram + jitter * eye
-    if k > _UNROLL_MAX_K or gram.ndim != 3:
+    if unroll is None:
+        # any non-cpu backend counts as TPU-like (the axon tunnel backend
+        # reports platform "axon" for real TPU chips)
+        unroll = jax.default_backend() != "cpu"
+    if not unroll or k > _UNROLL_MAX_K or gram.ndim != 3:
         chol = cholesky(gram)
         return cho_solve((chol, True), rhs[..., None])[..., 0]
     return _unrolled_chol_solve(gram, rhs)
