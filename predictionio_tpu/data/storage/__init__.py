@@ -48,6 +48,7 @@ _BACKENDS: dict[str, str] = {
     # postgres vs mysql (postgres when absent)
     "jdbc": "predictionio_tpu.data.storage.jdbc",
     "s3": "predictionio_tpu.data.storage.s3",
+    "hdfs": "predictionio_tpu.data.storage.hdfs",
 }
 
 _REPOS = ("METADATA", "EVENTDATA", "MODELDATA")

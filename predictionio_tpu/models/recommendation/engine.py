@@ -7,6 +7,7 @@ plus item-based queries ``{"items": [...], "num": k}`` for similarity.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 
 import numpy as np
@@ -28,6 +29,8 @@ from predictionio_tpu.parallel.als import (
     als_fit,
     build_als_data,
 )
+
+logger = logging.getLogger("pio.recommendation")
 
 
 @dataclass
@@ -186,21 +189,57 @@ class ALSAlgorithm(TPUAlgorithm):
         checkpoint = ctx.checkpoint_manager("als") if interval > 0 else None
         init, start_iteration, callback = None, 0, None
         if checkpoint is not None:
+            # dataset fingerprint: checkpointed factors are only meaningful
+            # against the id vocabularies they were trained on. Events
+            # ingested between crash and resume change num_users/num_items
+            # -- restoring would crash on shape mismatch or silently
+            # misalign factor rows with the new vocabulary. Counts alone
+            # are not enough (delete one user + add another keeps the count
+            # but renumbers rows), so the vocabularies themselves are
+            # hashed too.
+            import hashlib
+
+            def vocab_hash(ids: list[str]) -> str:
+                h = hashlib.sha256()
+                for s in ids:
+                    h.update(s.encode())
+                    h.update(b"\x00")
+                return h.hexdigest()[:16]
+
+            fingerprint = {
+                "num_users": ratings_data.num_users,
+                "num_items": ratings_data.num_items,
+                "user_vocab": vocab_hash(ratings_data.user_ids),
+                "item_vocab": vocab_hash(ratings_data.item_ids),
+                "rank": config.rank,
+            }
             latest = checkpoint.latest_step()
             if latest is not None:  # only a --resume run can see a step here
-                state = checkpoint.restore(
-                    {
-                        "users": np.zeros(
-                            (ratings_data.num_users, config.rank), np.float32
-                        ),
-                        "items": np.zeros(
-                            (ratings_data.num_items, config.rank), np.float32
-                        ),
-                        "iteration": 0,
-                    }
-                )
-                init = (state["users"], state["items"])
-                start_iteration = int(state["iteration"]) + 1
+                meta = checkpoint.read_meta()
+                if meta != fingerprint:
+                    logger.warning(
+                        "als checkpoint fingerprint %s does not match current"
+                        " dataset %s (events changed between crash and"
+                        " resume?); discarding checkpoints and training fresh",
+                        meta,
+                        fingerprint,
+                    )
+                    checkpoint.reset()
+                else:
+                    state = checkpoint.restore(
+                        {
+                            "users": np.zeros(
+                                (ratings_data.num_users, config.rank), np.float32
+                            ),
+                            "items": np.zeros(
+                                (ratings_data.num_items, config.rank), np.float32
+                            ),
+                            "iteration": 0,
+                        }
+                    )
+                    init = (state["users"], state["items"])
+                    start_iteration = int(state["iteration"]) + 1
+            checkpoint.write_meta(fingerprint)
 
             def callback(it, users_np, items_np):
                 checkpoint.save(

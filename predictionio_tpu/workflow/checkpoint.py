@@ -9,11 +9,103 @@ orbax writes ``{step, params, opt_state}``; ``latest_step`` lets a re-run
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 from typing import Any
 
 logger = logging.getLogger("pio.checkpoint")
+
+
+def _checkpoint_base(base_dir: str | None = None) -> str:
+    return base_dir or os.path.join(
+        os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store")),
+        "checkpoints",
+    )
+
+
+class RunLockHeld(RuntimeError):
+    """Another live process owns this run's checkpoint namespace."""
+
+    def __init__(self, run_key: str, pid: int):
+        super().__init__(
+            f"run {run_key!r} is locked by live pid {pid}: another train with"
+            " the same variant+params is running. Refusing to start (a fresh"
+            " train would delete its live checkpoints; --resume would adopt a"
+            " RUNNING instance). Wait for it or kill it first."
+        )
+        self.pid = pid
+
+
+class RunLock:
+    """``flock``-based lockfile serializing trains that share one run_key.
+
+    ``run_key`` is a pure function of variant+params (core_workflow), so two
+    concurrent identical trains would share a checkpoint dir: the second's
+    ``fresh`` wipe deletes the first's live checkpoints, and ``--resume``
+    would adopt a still-RUNNING instance.
+
+    Why flock and not a pid file: the kernel drops the lock the instant the
+    holder dies (no stale-pid liveness polling, which is both racy --
+    two waiters can each judge the lock stale and both 'take over' -- and
+    wrong across users, where ``kill(pid, 0)`` raises EPERM for a live
+    process). The pid written into the file is diagnostic only. Single-host
+    by design; multi-host pods isolate via per-host PIO_FS_BASEDIR or run
+    one train per coordinator.
+    """
+
+    def __init__(self, run_key: str, base_dir: str | None = None):
+        base = _checkpoint_base(base_dir)
+        os.makedirs(base, exist_ok=True)
+        self.run_key = run_key
+        self.path = os.path.join(base, f"{run_key}.lock")
+        self._fd: int | None = None
+
+    def acquire(self) -> "RunLock":
+        import fcntl
+
+        while True:
+            fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except BlockingIOError:
+                try:
+                    pid = int(os.read(fd, 32).decode().strip() or -1)
+                except (OSError, ValueError):
+                    pid = -1
+                os.close(fd)
+                raise RunLockHeld(self.run_key, pid) from None
+            except BaseException:
+                os.close(fd)
+                raise
+            # release() unlinks the path, so the inode we just locked may
+            # already be orphaned (opened before a concurrent release):
+            # verify fd and path still agree, else retry on the fresh file
+            try:
+                if os.fstat(fd).st_ino == os.stat(self.path).st_ino:
+                    break
+            except FileNotFoundError:
+                pass
+            os.close(fd)
+        os.ftruncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())
+        self._fd = fd
+        return self
+
+    def release(self) -> None:
+        if self._fd is not None:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+            os.close(self._fd)  # closing the fd drops the flock
+            self._fd = None
+
+    def __enter__(self) -> "RunLock":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
 
 
 class CheckpointManager:
@@ -31,22 +123,54 @@ class CheckpointManager:
         max_to_keep: int = 3,
         fresh: bool = False,
     ):
-        import orbax.checkpoint as ocp
-
-        base = base_dir or os.path.join(
-            os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store")),
-            "checkpoints",
+        self._max_to_keep = max_to_keep
+        self.path = os.path.abspath(
+            os.path.join(_checkpoint_base(base_dir), run_id)
         )
-        self.path = os.path.abspath(os.path.join(base, run_id))
         if fresh and os.path.isdir(self.path):
             import shutil
 
             shutil.rmtree(self.path)
+        self._open_manager()
+
+    def _open_manager(self) -> None:
+        import orbax.checkpoint as ocp
+
         os.makedirs(self.path, exist_ok=True)
         self._manager = ocp.CheckpointManager(
             self.path,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+            options=ocp.CheckpointManagerOptions(max_to_keep=self._max_to_keep),
         )
+
+    def reset(self) -> None:
+        """Discard every step + the meta sidecar (e.g. on a dataset-
+        fingerprint mismatch: stale factors must not pad/misalign into a
+        changed dataset)."""
+        import shutil
+
+        self._manager.close()
+        shutil.rmtree(self.path, ignore_errors=True)
+        self._open_manager()
+
+    # -- meta sidecar: small JSON facts checked BEFORE array restore --------
+    # (orbax restore needs a shape-matching template, so shape-invalidating
+    # facts like the dataset fingerprint cannot live inside the step state)
+    @property
+    def _meta_path(self) -> str:
+        return os.path.join(self.path, "pio_meta.json")
+
+    def write_meta(self, meta: dict) -> None:
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._meta_path)
+
+    def read_meta(self) -> dict | None:
+        try:
+            with open(self._meta_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
 
     def save(self, step: int, state: Any) -> None:
         import orbax.checkpoint as ocp

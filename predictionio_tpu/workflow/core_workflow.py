@@ -99,6 +99,33 @@ def run_train(
         ),
         json.dumps(dict(engine_params.serving_params)),
     )
+    run_key = _run_key(variant, params_jsons)
+
+    # serialize trains sharing this run_key: a second identical train would
+    # wipe the first's live step checkpoints (fresh=True) and --resume would
+    # adopt its still-RUNNING instance. Raises RunLockHeld when the holder
+    # is alive; a crashed holder's stale lock is taken over silently.
+    from predictionio_tpu.workflow.checkpoint import RunLock
+
+    run_lock = RunLock(run_key).acquire()
+    try:
+        return _run_train_locked(
+            variant, workflow_params, engine, engine_params, instances,
+            params_jsons, run_key,
+        )
+    finally:
+        run_lock.release()
+
+
+def _run_train_locked(
+    variant: EngineVariant,
+    workflow_params: WorkflowParams,
+    engine: Engine,
+    engine_params: EngineParams,
+    instances,
+    params_jsons: tuple[str, ...],
+    run_key: str,
+) -> EngineInstance:
     ds_json, prep_json, algorithms_params_json, serving_json = params_jsons
     instance = None
     resume = False
@@ -152,7 +179,7 @@ def run_train(
     ctx = RuntimeContext(
         variant.runtime_conf,
         instance_id=instance_id,
-        run_key=_run_key(variant, params_jsons),
+        run_key=run_key,
         resume=resume,
     )
     profile_dir = variant.runtime_conf.get("pio.profile")

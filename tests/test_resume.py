@@ -225,6 +225,112 @@ class TestResumeWorkflow:
         leftovers = os.listdir(ckpt_root) if os.path.isdir(ckpt_root) else []
         assert leftovers == []
 
+    def test_resume_after_dataset_change_starts_fresh(
+        self, storage_env, tmp_path, monkeypatch
+    ):
+        """Events ingested between crash and resume change num_users/
+        num_items: the checkpoint's dataset fingerprint no longer matches,
+        so resume must discard the factors and train fresh -- not crash on
+        a shape mismatch or silently misalign factor rows with the new id
+        vocabulary."""
+        app_id = seed_ratings(storage_env)
+        variant = als_variant(tmp_path)
+        crasher = CrashAfter(crash_step=2)
+        try:
+            with pytest.raises(RuntimeError, match="preemption"):
+                run_train(variant)
+        finally:
+            crasher.restore()
+
+        # new users AND items arrive while the train was down
+        le = storage_env.get_l_events()
+        le.batch_insert(
+            [
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"new_u{k}",
+                    target_entity_type="item",
+                    target_entity_id=f"new_i{k}",
+                    properties=DataMap({"rating": 4.0}),
+                )
+                for k in range(3)
+            ],
+            app_id=app_id,
+        )
+
+        from predictionio_tpu.models.recommendation import engine as rec_engine
+        from predictionio_tpu.parallel import als as als_mod
+
+        starts = []
+        real_fit = als_mod.als_fit
+
+        def spying_fit(*args, **kwargs):
+            starts.append(kwargs.get("start_iteration", 0))
+            return real_fit(*args, **kwargs)
+
+        monkeypatch.setattr(rec_engine, "als_fit", spying_fit)
+        resumed = run_train(variant, WorkflowParams(resume=True))
+        assert resumed.status == STATUS_COMPLETED
+        assert starts == [0]  # fingerprint mismatch -> clean fresh start
+
+    def test_concurrent_train_with_same_params_is_refused(
+        self, storage_env, tmp_path
+    ):
+        """Two live trains sharing a run_key would share a checkpoint dir
+        (the second's fresh-wipe deletes the first's live checkpoints);
+        the run lock must refuse the second while the holder is alive."""
+        from predictionio_tpu.workflow.checkpoint import RunLock, RunLockHeld
+        from predictionio_tpu.workflow.core_workflow import _run_key
+
+        seed_ratings(storage_env)
+        variant = als_variant(tmp_path)
+        params_jsons = (
+            json.dumps(dict(variant.engine_params.data_source_params)),
+            json.dumps(dict(variant.engine_params.preparator_params)),
+            json.dumps(
+                [
+                    {"name": n, "params": dict(p)}
+                    for n, p in variant.engine_params.algorithm_params_list
+                ]
+            ),
+            json.dumps(dict(variant.engine_params.serving_params)),
+        )
+        holder = RunLock(_run_key(variant, params_jsons)).acquire()
+        try:
+            with pytest.raises(RunLockHeld, match="live pid"):
+                run_train(variant)
+            with pytest.raises(RunLockHeld):
+                run_train(variant, WorkflowParams(resume=True))
+        finally:
+            holder.release()
+        # holder gone -> train proceeds normally
+        assert run_train(variant).status == STATUS_COMPLETED
+
+    def test_stale_lock_from_dead_process_is_taken_over(
+        self, storage_env, tmp_path
+    ):
+        from predictionio_tpu.workflow.checkpoint import RunLock
+        from predictionio_tpu.workflow.core_workflow import _run_key
+
+        seed_ratings(storage_env)
+        variant = als_variant(tmp_path)
+        # a process that crashed without releasing: its pid is dead
+        proc = subprocess.Popen(["sleep", "0"])
+        proc.wait()
+        lock = RunLock("deadbeef00000000")
+        with open(lock.path, "w") as f:
+            f.write(str(proc.pid))
+        import predictionio_tpu.workflow.core_workflow as cw
+
+        real = cw._run_key
+        try:
+            cw._run_key = lambda *a, **k: "deadbeef00000000"
+            assert run_train(variant).status == STATUS_COMPLETED
+        finally:
+            cw._run_key = real
+        assert not os.path.exists(lock.path)  # released after the train
+
 
 _KILL_SCRIPT = """
 import os, sys
