@@ -175,6 +175,9 @@ class ALSAlgorithm(TPUAlgorithm):
             alpha=p.get_or("alpha", 40.0),
             implicit=p.get_or("implicitPrefs", False),
             seed=p.get_or("seed", 0),
+            # "bfloat16" halves factor HBM/ICI traffic on TPU (ALX-style
+            # mixed precision: f32 Grams + solve, bf16 storage/gathers)
+            dtype=p.get_or("factorDtype", "float32"),
         )
 
     def train(self, ctx, prepared) -> RecommendationModel:

@@ -82,34 +82,54 @@ def _half_step_explicit(indices, values, mask, factors, reg, rank, unroll):
     """Solve one side's factors given the other side's (replicated) factors.
 
     factors carries a trailing zero row so padding gathers are in-bounds.
+    Mixed precision, ALX-style: factors may be bf16 (half the HBM traffic
+    for the gather and half the ICI traffic for the all-gather; bf16 inputs
+    are the MXU's native mode), while the Gram/rhs accumulate in f32 and
+    the normal-equation solve runs in f32; the solution is cast back to the
+    factor dtype on return.
     """
     gathered = factors[indices]                       # [R, L, K]
-    gathered = gathered * mask[..., None]
-    gram = jnp.einsum("rlk,rlj->rkj", gathered, gathered, precision="highest")
+    gathered = gathered * mask[..., None].astype(factors.dtype)
+    gram = jnp.einsum(
+        "rlk,rlj->rkj", gathered, gathered,
+        precision="highest", preferred_element_type=jnp.float32,
+    )
     # MLlib-style weighted regularization: lambda * n_obs (ALS-WR); constant
     # lambda would also be defensible -- n_obs matches the reference template
     n_obs = mask.sum(axis=1)
     ridge = reg * jnp.maximum(n_obs, 1.0)
     gram = gram + ridge[:, None, None] * jnp.eye(rank, dtype=gram.dtype)
-    rhs = jnp.einsum("rlk,rl->rk", gathered, values * mask, precision="highest")
-    return batched_spd_solve(gram, rhs, unroll=unroll)
+    rhs = jnp.einsum(
+        "rlk,rl->rk", gathered, values * mask,
+        precision="highest", preferred_element_type=jnp.float32,
+    )
+    return batched_spd_solve(gram, rhs, unroll=unroll).astype(factors.dtype)
 
 
 def _half_step_implicit(indices, values, mask, factors, reg, alpha, rank, unroll):
     """Hu-Koren-Volinsky implicit step with the YtY trick.
 
     G = YtY + sum_obs (c-1) y y^T + lam*I ; rhs = sum_obs c * y
+    Same mixed-precision contract as the explicit step: bf16-capable factor
+    storage, f32 Gram accumulation and solve.
     """
     active = factors[:-1]  # drop the padding row from the global Gram
-    yty = jnp.einsum("nk,nj->kj", active, active, precision="highest")
-    gathered = factors[indices] * mask[..., None]     # [R, L, K]
+    yty = jnp.einsum(
+        "nk,nj->kj", active, active,
+        precision="highest", preferred_element_type=jnp.float32,
+    )
+    gathered = factors[indices] * mask[..., None].astype(factors.dtype)
     conf_minus_1 = alpha * values * mask
     gram_fix = jnp.einsum(
-        "rlk,rl,rlj->rkj", gathered, conf_minus_1, gathered, precision="highest"
+        "rlk,rl,rlj->rkj", gathered, conf_minus_1, gathered,
+        precision="highest", preferred_element_type=jnp.float32,
     )
     gram = yty[None] + gram_fix + reg * jnp.eye(rank, dtype=yty.dtype)
-    rhs = jnp.einsum("rlk,rl->rk", gathered, (1.0 + conf_minus_1) * mask)
-    return batched_spd_solve(gram, rhs, unroll=unroll)
+    rhs = jnp.einsum(
+        "rlk,rl->rk", gathered, (1.0 + conf_minus_1) * mask,
+        preferred_element_type=jnp.float32,
+    )
+    return batched_spd_solve(gram, rhs, unroll=unroll).astype(factors.dtype)
 
 
 def _append_zero_row(factors: jnp.ndarray) -> jnp.ndarray:
@@ -215,6 +235,14 @@ def als_fit(
     from predictionio_tpu.parallel.mesh import local_mesh
 
     mesh = mesh or local_mesh(1, 1)
+    if config.dtype not in ("float32", "bfloat16"):
+        # e.g. an integer dtype would truncate the N(0, 1/sqrt(K)) init to
+        # all zeros -- a fixed point of the update -- and train a silently
+        # degenerate model
+        raise ValueError(
+            f"ALSConfig.dtype must be 'float32' or 'bfloat16', got"
+            f" {config.dtype!r}"
+        )
     dtype = jnp.dtype(config.dtype)
     scale = 1.0 / np.sqrt(config.rank)
 
@@ -268,13 +296,21 @@ def als_fit(
         ):
             # host copies: the device buffers are donated into the next
             # iteration; handing them out would raise 'Array has been
-            # deleted' one iteration later, far from the cause
+            # deleted' one iteration later, far from the cause. f32 on the
+            # host regardless of the on-device factor dtype: checkpoints
+            # and serving stay dtype-stable across bf16 runs
             callback(
                 it,
-                np.asarray(user_factors)[: data.by_row.num_rows].copy(),
-                np.asarray(item_factors)[: data.by_col.num_rows].copy(),
+                np.asarray(user_factors)[: data.by_row.num_rows].astype(
+                    np.float32
+                ),
+                np.asarray(item_factors)[: data.by_col.num_rows].astype(
+                    np.float32
+                ),
             )
 
-    user_np = np.asarray(user_factors)[: data.by_row.num_rows]
-    item_np = np.asarray(item_factors)[: data.by_col.num_rows]
+    # serving model is always f32 host-side (numpy top-k math on bf16 via
+    # ml_dtypes is slow and lossy; the dtype knob is a TRAINING layout)
+    user_np = np.asarray(user_factors)[: data.by_row.num_rows].astype(np.float32)
+    item_np = np.asarray(item_factors)[: data.by_col.num_rows].astype(np.float32)
     return ALSModel(user_factors=user_np, item_factors=item_np)

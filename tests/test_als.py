@@ -43,6 +43,44 @@ class TestExplicitALS:
         r8 = m8.user_factors[uu[:50]] @ m8.item_factors[ii[:50]].T
         np.testing.assert_allclose(r1, r8, atol=2e-2)
 
+    def test_bfloat16_factor_mode(self, synthetic):
+        """ALX-style mixed precision: bf16 factor storage on device, f32
+        Grams/solve. Quality must track the f32 run, the on-device factors
+        must actually STAY bf16 across iterations (a promotion anywhere in
+        the step would silently upcast after iteration 1), and the serving
+        model must come back f32."""
+        import jax.numpy as jnp
+
+        from predictionio_tpu.parallel.als import _half_step_explicit
+
+        n_u, n_i, uu, ii, rr, _ = synthetic
+        cfg16 = ALSConfig(rank=6, iterations=10, reg=0.01, seed=1, dtype="bfloat16")
+        data = build_als_data(uu, ii, rr, n_u, n_i, cfg16)
+        model = als_fit(data, cfg16, local_mesh(1, 1))
+        assert model.user_factors.dtype == np.float32  # host model is f32
+        pred = np.sum(model.user_factors[uu] * model.item_factors[ii], axis=1)
+        assert np.sqrt(np.mean((pred - rr) ** 2)) < 0.08  # tracks f32 (<0.05)
+
+        # the step's output dtype == its input factor dtype (no promotion)
+        factors16 = jnp.zeros((n_i + 1, 6), jnp.bfloat16)
+        out = _half_step_explicit(
+            jnp.asarray(data.by_row.indices),
+            jnp.asarray(data.by_row.values),
+            jnp.asarray(data.by_row.mask),
+            factors16,
+            reg=0.01,
+            rank=6,
+            unroll=False,
+        )
+        assert out.dtype == jnp.bfloat16
+
+    def test_invalid_factor_dtype_rejected(self, synthetic):
+        n_u, n_i, uu, ii, rr, _ = synthetic
+        cfg = ALSConfig(rank=6, iterations=1, dtype="int8")
+        data = build_als_data(uu, ii, rr, n_u, n_i, cfg)
+        with pytest.raises(ValueError, match="float32.*bfloat16"):
+            als_fit(data, cfg, local_mesh(1, 1))
+
     def test_model_scoring_helpers(self, synthetic):
         n_u, n_i, uu, ii, rr, _ = synthetic
         cfg = ALSConfig(rank=6, iterations=3, reg=0.05)
