@@ -127,7 +127,7 @@ def _half_step_implicit(indices, values, mask, factors, reg, alpha, rank, unroll
     gram = yty[None] + gram_fix + reg * jnp.eye(rank, dtype=yty.dtype)
     rhs = jnp.einsum(
         "rlk,rl->rk", gathered, (1.0 + conf_minus_1) * mask,
-        preferred_element_type=jnp.float32,
+        precision="highest", preferred_element_type=jnp.float32,
     )
     return batched_spd_solve(gram, rhs, unroll=unroll).astype(factors.dtype)
 

@@ -133,3 +133,79 @@ class TestBuildVerbs:
         assert code == 1
         assert "exists" in out
         assert target.read_text() == "keep me"
+
+
+class TestImportExport:
+    def _seed(self, capsys, tmp_path, n=120):
+        import datetime as dt
+        import json
+
+        run(capsys, "app", "new", "IO")
+        src = tmp_path / "events.jsonl"
+        base = dt.datetime(2022, 5, 1, tzinfo=dt.timezone.utc)
+        with open(src, "w") as f:
+            for i in range(n):
+                f.write(json.dumps({
+                    "event": "buy" if i % 3 else "$set",
+                    "entityType": "user", "entityId": f"u{i % 7}",
+                    **({"targetEntityType": "item", "targetEntityId": f"i{i % 5}"}
+                       if i % 3 else {"properties": {"vip": True}}),
+                    "eventTime": (base + dt.timedelta(minutes=i)).isoformat(),
+                }) + "\n")
+        code, out = run(capsys, "import", "--appid", "1", "--input", str(src))
+        assert code == 0 and f"Imported {n} events" in out
+        return n
+
+    def test_json_round_trip(self, storage_env, tmp_path, capsys):
+        import json
+
+        n = self._seed(capsys, tmp_path)
+        out_path = tmp_path / "out.jsonl"
+        code, out = run(capsys, "export", "--appid", "1", "--output", str(out_path))
+        assert code == 0 and f"Exported {n} events" in out
+        rows = [json.loads(l) for l in out_path.read_text().splitlines()]
+        assert len(rows) == n
+        assert all("event" in r and "entityId" in r for r in rows)
+
+    def test_parquet_round_trip(self, storage_env, tmp_path, capsys):
+        """export --format parquet -> import reads it back (reference
+        EventsToFile json/parquet parity, SURVEY 2.4 #30)."""
+        n = self._seed(capsys, tmp_path)
+        pq = tmp_path / "out.parquet"
+        code, out = run(capsys, "export", "--appid", "1",
+                        "--output", str(pq), "--format", "parquet")
+        assert code == 0 and f"Exported {n} events" in out
+
+        # import the parquet into a second app; full fidelity round trip
+        run(capsys, "app", "new", "IO2")
+        code, out = run(capsys, "import", "--appid", "2", "--input", str(pq))
+        assert code == 0 and f"Imported {n} events" in out
+
+        from predictionio_tpu.data import storage as reg
+
+        a = sorted(
+            (e.event, e.entity_id, e.target_entity_id, e.event_time,
+             e.properties.to_dict())
+            for e in reg.get_l_events().find(1)
+        )
+        b = sorted(
+            (e.event, e.entity_id, e.target_entity_id, e.event_time,
+             e.properties.to_dict())
+            for e in reg.get_l_events().find(2)
+        )
+        assert a == b
+
+    def test_bad_rows_are_rejected_not_fatal(self, storage_env, tmp_path, capsys):
+        import json
+
+        run(capsys, "app", "new", "IO")
+        src = tmp_path / "events.jsonl"
+        with open(src, "w") as f:
+            f.write(json.dumps({"event": "buy", "entityType": "user",
+                                "entityId": "u1"}) + "\n")
+            f.write("{not json\n")
+            f.write(json.dumps({"event": "pio_reserved", "entityType": "user",
+                                "entityId": "u2"}) + "\n")
+        code, out = run(capsys, "import", "--appid", "1", "--input", str(src))
+        assert code == 1  # errors reported
+        assert "Imported 1 events (2 rejected)" in out
