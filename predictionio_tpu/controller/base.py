@@ -27,7 +27,7 @@ Key redesign decisions (TPU-first, not a translation):
 from __future__ import annotations
 
 import abc
-from typing import Any, Generic, Iterable, Mapping, Sequence, TypeVar
+from typing import Any, Generic, Mapping, Sequence, TypeVar
 
 
 class Params(dict):

@@ -21,12 +21,11 @@ Default port 7070.
 
 from __future__ import annotations
 
-import datetime as _dt
 import json
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any
 
 from predictionio_tpu.data import storage as storage_registry
 from predictionio_tpu.data.event import (
@@ -36,6 +35,7 @@ from predictionio_tpu.data.event import (
 )
 from predictionio_tpu.data.storage.base import AccessKey
 from predictionio_tpu.data import webhooks as webhook_registry
+from predictionio_tpu.utils import metrics as metrics_mod
 from predictionio_tpu.utils.http import (
     Request,
     Response,
@@ -104,9 +104,11 @@ class EventService:
         self.stats_enabled = stats
         self.stats = _Stats()
         self.plugins = list(plugins or [])
-        self.router = Router()
+        self.metrics = metrics_mod.MetricsRegistry()
+        self.router = Router(metrics=self.metrics)
         r = self.router
         r.add("GET", "/", self.handle_root)
+        r.add("GET", "/metrics", self.handle_metrics)
         r.add("POST", "/events.json", self.handle_create_event)
         r.add("GET", "/events.json", self.handle_find_events)
         r.add("GET", "/events/<event_id>.json", self.handle_get_event)
@@ -164,6 +166,11 @@ class EventService:
     def handle_root(self, request: Request) -> Response:
         return Response(200, {"status": "alive"})
 
+    def handle_metrics(self, request: Request) -> Response:
+        return Response(
+            200, self.metrics.exposition(), content_type=metrics_mod.CONTENT_TYPE
+        )
+
     def _insert_one(
         self, obj: Any, record: AccessKey, channel_id: int | None
     ) -> tuple[int, dict[str, Any]]:
@@ -183,6 +190,11 @@ class EventService:
                 plugin.input_sniffer(event, record.app_id, channel_id)
             if self.stats_enabled:
                 self.stats.record(record.app_id, event.event, 201)
+            self.metrics.inc(
+                "pio_events_ingested_total",
+                {"app_id": str(record.app_id)},
+                help="Events accepted into the event store",
+            )
             return 201, {"eventId": event_id}
         except EventValidationError as exc:
             if self.stats_enabled:

@@ -14,7 +14,6 @@ minimum. On CPU test backends the kernel runs in interpret mode.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

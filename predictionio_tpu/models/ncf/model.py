@@ -11,7 +11,6 @@ training step jits cleanly with explicit shardings:
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Any
 

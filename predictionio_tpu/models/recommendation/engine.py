@@ -24,7 +24,6 @@ from predictionio_tpu.controller.base import SanityCheck
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.parallel.als import (
     ALSConfig,
-    ALSData,
     ALSModel,
     als_fit,
     build_als_data,

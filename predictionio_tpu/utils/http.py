@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import traceback
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -58,14 +59,23 @@ Handler = Callable[[Request], Response]
 
 
 class Router:
-    """Route table: (method, path regex with <name> captures) -> handler."""
+    """Route table: (method, path regex with <name> captures) -> handler.
 
-    def __init__(self):
-        self._routes: list[tuple[str, re.Pattern, Handler]] = []
+    With a ``metrics`` registry attached (``utils.metrics``), every dispatch
+    records ``pio_http_requests_total{method,route,status}`` and a
+    ``pio_http_request_duration_seconds`` histogram, labeled by the ROUTE
+    PATTERN (bounded cardinality), not the raw path.
+    """
+
+    def __init__(self, metrics=None):
+        self._routes: list[tuple[str, str, re.Pattern, Handler]] = []
+        self.metrics = metrics
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
         regex = re.sub(r"<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", pattern)
-        self._routes.append((method.upper(), re.compile(f"^{regex}$"), handler))
+        self._routes.append(
+            (method.upper(), pattern, re.compile(f"^{regex}$"), handler)
+        )
 
     def route(self, method: str, pattern: str):
         def deco(fn: Handler) -> Handler:
@@ -75,19 +85,53 @@ class Router:
         return deco
 
     def dispatch(self, request: Request) -> Response:
+        t0 = time.perf_counter()
+        route_label = "<unmatched>"
         path_matched = False
-        for method, regex, handler in self._routes:
+        response = None
+        for method, pattern, regex, handler in self._routes:
             m = regex.match(request.path)
             if not m:
                 continue
-            path_matched = True
+            if not path_matched:
+                path_matched = True
+                route_label = pattern  # known even for a 405 below
             if method != request.method:
                 continue
             request.path_params = m.groupdict()
-            return handler(request)
-        if path_matched:
-            return Response(405, {"message": "method not allowed"})
-        return Response(404, {"message": "not found"})
+            route_label = pattern
+            try:
+                response = handler(request)
+            except json.JSONDecodeError:
+                # same mapping the server backstop applies -- handled here
+                # so the metric records the 400 the client actually gets
+                response = Response(400, {"message": "malformed JSON body"})
+            except BaseException:
+                self._record(request, route_label, 500, t0)
+                raise
+            break
+        if response is None:
+            response = (
+                Response(405, {"message": "method not allowed"})
+                if path_matched
+                else Response(404, {"message": "not found"})
+            )
+        self._record(request, route_label, response.status, t0)
+        return response
+
+    def _record(self, request: Request, route: str, status: int, t0: float) -> None:
+        if self.metrics is None:
+            return
+        labels = {"method": request.method, "route": route, "status": str(status)}
+        self.metrics.inc(
+            "pio_http_requests_total", labels, help="HTTP requests served"
+        )
+        self.metrics.observe(
+            "pio_http_request_duration_seconds",
+            time.perf_counter() - t0,
+            {"route": route},
+            help="Request handling latency",
+        )
 
 
 _CORS_HEADERS = {

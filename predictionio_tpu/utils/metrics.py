@@ -1,0 +1,134 @@
+"""Dependency-free Prometheus-text metrics.
+
+SURVEY.md section 5.5: the reference had no metrics endpoint (log4j +
+`/stats.json` only); the rebuild plan calls for structured logging "+
+optional Prometheus". This module is that option without a client-library
+dependency: counters and fixed-bucket histograms with the text exposition
+format any Prometheus/OpenMetrics scraper ingests.
+
+Services attach a registry to their Router (per-request method/route/status
+counts + latency histograms are recorded centrally in ``Router.dispatch``)
+and expose ``GET /metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping
+
+#: latency buckets (seconds): sub-ms serving up to slow storage calls
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0,
+)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape(value: str) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _fmt_labels(labels: Mapping[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    """Thread-safe counters + histograms with Prometheus text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> help text
+        self._help: dict[str, str] = {}
+        # name -> {sorted-label-tuple -> float}
+        self._counters: dict[str, dict[tuple, float]] = {}
+        # name -> (buckets, {sorted-label-tuple -> [bucket counts..., sum, count]})
+        self._histograms: dict[str, tuple[tuple, dict[tuple, list]]] = {}
+
+    def inc(
+        self,
+        name: str,
+        labels: Mapping[str, str] | None = None,
+        amount: float = 1.0,
+        help: str = "",
+    ) -> None:
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            if help:
+                self._help.setdefault(name, help)
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + amount
+
+    def set_counter(
+        self,
+        name: str,
+        value: float,
+        labels: Mapping[str, str] | None = None,
+        help: str = "",
+    ) -> None:
+        """Pin a counter to an externally-tracked value (single source of
+        truth lives elsewhere; the registry only exposes it)."""
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            if help:
+                self._help.setdefault(name, help)
+            self._counters.setdefault(name, {})[key] = float(value)
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        labels: Mapping[str, str] | None = None,
+        buckets: tuple = DEFAULT_BUCKETS,
+        help: str = "",
+    ) -> None:
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            if help:
+                self._help.setdefault(name, help)
+            bucket_spec, series = self._histograms.setdefault(
+                name, (tuple(buckets), {})
+            )
+            row = series.setdefault(key, [0] * (len(bucket_spec) + 1) + [0.0, 0])
+            for i, le in enumerate(bucket_spec):
+                if value <= le:
+                    row[i] += 1
+            row[len(bucket_spec)] += 1        # +Inf bucket
+            row[-2] += value                  # sum
+            row[-1] += 1                      # count
+
+    def exposition(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            for name, series in sorted(self._counters.items()):
+                if name in self._help:
+                    lines.append(f"# HELP {name} {self._help[name]}")
+                lines.append(f"# TYPE {name} counter")
+                for key, value in sorted(series.items()):
+                    # .17g, not %g: %g rounds to 6 significant digits, which
+                    # freezes large counters between scrapes and breaks rate()
+                    lines.append(f"{name}{_fmt_labels(dict(key))} {value:.17g}")
+            for name, (buckets, series) in sorted(self._histograms.items()):
+                if name in self._help:
+                    lines.append(f"# HELP {name} {self._help[name]}")
+                lines.append(f"# TYPE {name} histogram")
+                for key, row in sorted(series.items()):
+                    labels = dict(key)
+                    # rows store per-bucket CUMULATIVE counts already
+                    # (observe increments every bucket with value <= le)
+                    for i, le in enumerate(buckets):
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_fmt_labels({**labels, 'le': f'{le:g}'})}"
+                            f" {row[i]}"
+                        )
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})}"
+                        f" {row[len(buckets)]}"
+                    )
+                    lines.append(f"{name}_sum{_fmt_labels(labels)} {row[-2]:.17g}")
+                    lines.append(f"{name}_count{_fmt_labels(labels)} {row[-1]}")
+        return "\n".join(lines) + "\n"

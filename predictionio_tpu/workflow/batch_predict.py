@@ -9,7 +9,6 @@ deployed-equivalent model chain.
 from __future__ import annotations
 
 import json
-from typing import Iterable
 
 from predictionio_tpu.data import storage
 from predictionio_tpu.workflow.context import RuntimeContext

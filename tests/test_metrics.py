@@ -1,0 +1,149 @@
+"""Prometheus-text metrics: registry exposition + live /metrics endpoints
+(SURVEY.md section 5.5 -- the rebuild's "optional Prometheus" observability;
+the reference had only log4j + /stats.json)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.utils.metrics import DEFAULT_BUCKETS, MetricsRegistry
+
+
+class TestRegistry:
+    def test_counter_accumulates_per_label_set(self):
+        m = MetricsRegistry()
+        m.inc("hits_total", {"route": "/a"}, help="hits")
+        m.inc("hits_total", {"route": "/a"})
+        m.inc("hits_total", {"route": "/b"})
+        text = m.exposition()
+        assert "# HELP hits_total hits" in text
+        assert "# TYPE hits_total counter" in text
+        assert 'hits_total{route="/a"} 2' in text
+        assert 'hits_total{route="/b"} 1' in text
+
+    def test_histogram_buckets_are_cumulative(self):
+        m = MetricsRegistry()
+        for v in (0.0004, 0.002, 0.02, 7.0):
+            m.observe("lat_seconds", v)
+        text = m.exposition()
+        assert 'lat_seconds_bucket{le="0.0005"} 1' in text
+        assert 'lat_seconds_bucket{le="0.0025"} 2' in text
+        assert 'lat_seconds_bucket{le="0.025"} 3' in text
+        assert 'lat_seconds_bucket{le="10"} 4' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+        assert "lat_seconds_count 4" in text
+        assert abs(float(text.split("lat_seconds_sum ")[1].split("\n")[0]) - 7.0224) < 1e-6
+
+    def test_label_escaping(self):
+        m = MetricsRegistry()
+        m.inc("c_total", {"q": 'say "hi"\\now'})
+        assert 'q="say \\"hi\\"\\\\now"' in m.exposition()
+
+    def test_default_buckets_cover_sub_ms_to_slow(self):
+        assert DEFAULT_BUCKETS[0] <= 0.0005 and DEFAULT_BUCKETS[-1] >= 10
+
+
+def _get(url: str) -> tuple[int, str]:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _post(url: str, payload) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.load(resp)
+
+
+class TestEventServerMetrics:
+    def test_requests_and_ingest_counters(self, storage_env):
+        from predictionio_tpu.data.api.eventserver import create_event_server
+        from predictionio_tpu.data.storage.base import AccessKey, App
+
+        app_id = storage_env.get_meta_data_apps().insert(App(name="M"))
+        key = storage_env.get_meta_data_access_keys().insert(
+            AccessKey(key=None, app_id=app_id, events=[])
+        )
+        storage_env.get_l_events().init_channel(app_id)
+        thread = create_event_server(host="127.0.0.1", port=0).start()
+        base = f"http://127.0.0.1:{thread.port}"
+        try:
+            for _ in range(3):
+                _post(f"{base}/events.json?accessKey={key}", {
+                    "event": "buy", "entityType": "user", "entityId": "u1",
+                })
+            with pytest.raises(urllib.error.HTTPError):
+                _post(f"{base}/events.json", {"event": "x", "entityType": "u",
+                                              "entityId": "1"})  # 401
+            status, text = _get(f"{base}/metrics")
+        finally:
+            thread.stop()
+        assert status == 200
+        assert (
+            'pio_events_ingested_total{app_id="%d"} 3' % app_id in text
+        )
+        assert (
+            'pio_http_requests_total{method="POST",route="/events.json",status="201"} 3'
+            in text
+        )
+        assert (
+            'pio_http_requests_total{method="POST",route="/events.json",status="401"} 1'
+            in text
+        )
+        # latency histogram labeled by ROUTE PATTERN, not raw path
+        assert 'pio_http_request_duration_seconds_bucket{le="+Inf",route="/events.json"}' in text
+
+
+class TestQueryServerMetrics:
+    def test_queries_served_counter(self, storage_env, tmp_path):
+        import numpy as np
+
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.workflow.core_workflow import run_train
+        from predictionio_tpu.workflow.create_server import create_query_server
+        from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+        app_id = storage_env.get_meta_data_apps().insert(App(name="MQ"))
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        rng = np.random.default_rng(0)
+        le.batch_insert(
+            [
+                Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{int(i)}",
+                      properties=DataMap({"rating": float(rng.integers(1, 6))}))
+                for u in range(8) for i in rng.choice(6, 3, replace=False)
+            ],
+            app_id,
+        )
+        variant_path = tmp_path / "engine.json"
+        variant_path.write_text(json.dumps({
+            "id": "m", "engineFactory":
+                "predictionio_tpu.models.recommendation.engine.engine_factory",
+            "datasource": {"params": {"appName": "MQ"}},
+            "algorithms": [{"name": "als", "params":
+                            {"rank": 4, "numIterations": 2, "lambda": 0.05}}],
+            "sparkConf": {"pio.mesh_shape": [1, 1]},
+        }))
+        variant = load_engine_variant(str(variant_path))
+        run_train(variant)
+        thread, service = create_query_server(variant, host="127.0.0.1", port=0)
+        thread.start()
+        base = f"http://127.0.0.1:{thread.port}"
+        try:
+            _post(f"{base}/queries.json", {"user": "u1", "num": 2})
+            _post(f"{base}/queries.json", {"user": "u2", "num": 2})
+            status, text = _get(f"{base}/metrics")
+        finally:
+            thread.stop()
+        assert status == 200
+        assert "pio_queries_served_total 2" in text
+        assert (
+            'pio_http_requests_total{method="POST",route="/queries.json",status="200"} 2'
+            in text
+        )
