@@ -478,12 +478,14 @@ def _run_phases(bench: _Bench) -> None:
         tpu_platform = _probe_tpu(probe_budget)
 
     # Phase 3: full-scale measurement on the best available platform.
+    tpu_measured = False
     if tpu_platform and bench.remaining() > 60:
         full = _run_child(
             "tpu", full_scale, bench.remaining() - 30, phase="tpu_full",
             tpu_platform=tpu_platform,
         )
         if full:
+            tpu_measured = True
             tpu_sec = full["sec_per_iter"]
             flops = full["flops_per_iter"]
             achieved = flops / tpu_sec
@@ -519,10 +521,15 @@ def _run_phases(bench: _Bench) -> None:
                     "edges": bench.edges,
                 }
             )
-    elif bench.remaining() > 240 and not (small and small_scale == full_scale):
-        # no TPU: upgrade the provisional scaled number to a measured
-        # full-scale CPU run if the deadline allows (pointless when the
-        # "small" phase already measured this exact scale)
+    if (
+        not tpu_measured
+        and bench.remaining() > 240
+        and not (small and small_scale == full_scale)
+    ):
+        # no TPU number (probe failed, or the TPU child itself died):
+        # upgrade the provisional scaled number to a measured full-scale
+        # CPU run if the deadline allows (pointless when the "small" phase
+        # already measured this exact scale)
         full = _run_child("cpu", full_scale, bench.remaining() - 30, phase="cpu_full")
         if full:
             bench.edges = full["edges"]
