@@ -536,11 +536,15 @@ def _run_phases(bench: _Bench) -> None:
             history = _load_history()
             last_tpu = history[-1] if history else None
             probe_tail = "; ".join(p["result"] for p in EVIDENCE["probes"][-1:])
-            note = (
-                f"cpu only (no TPU backend reachable: {probe_tail})"
-                if want_tpu
-                else "cpu only (PIO_BENCH_PLATFORM=cpu)"
-            )
+            if not want_tpu:
+                note = "cpu only (PIO_BENCH_PLATFORM=cpu)"
+            elif tpu_platform:
+                note = (
+                    f"cpu only (TPU probe ok but the {tpu_platform}"
+                    " measurement child failed/timed out)"
+                )
+            else:
+                note = f"cpu only (no TPU backend reachable: {probe_tail})"
             if last_tpu:
                 note += (
                     f"; last known TPU: {last_tpu['value_iters_per_sec']} it/s"

@@ -218,6 +218,10 @@ class LogisticRegressionAlgorithm(_ClassifierBase):
 
     def train(self, ctx, prepared) -> ClassifierModel:
         space, x, y = prepared
+        try:
+            mesh = ctx.mesh  # dp over examples; see train_logistic_regression
+        except Exception:
+            mesh = None  # no devices available (pure-host tests)
         model = train_logistic_regression(
             x,
             y,
@@ -225,6 +229,7 @@ class LogisticRegressionAlgorithm(_ClassifierBase):
             reg=self.params.get_or("reg", 1e-4),
             iterations=self.params.get_or("iterations", 100),
             learning_rate=self.params.get_or("learningRate", 0.1),
+            mesh=mesh,
         )
         return ClassifierModel(space=space, inner=model)
 

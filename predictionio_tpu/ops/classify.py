@@ -76,18 +76,48 @@ def train_logistic_regression(
     reg: float = 1e-4,
     iterations: int = 100,
     learning_rate: float = 0.1,
+    mesh=None,
 ) -> LogisticRegressionModel:
-    x_j = jnp.asarray(x)
-    y_j = jnp.asarray(y)
+    """Full-batch multinomial logistic regression.
+
+    With ``mesh``, examples shard over the ``data`` axis (rows padded to
+    the axis size with zero-weight samples so the mean is exact) and
+    parameters replicate; the gradient's cross-example reductions become
+    XLA-inserted psums over ICI -- the Spark-executor data parallelism of
+    MLlib's LogisticRegressionWithLBFGS, rebuilt as GSPMD sharding.
+    """
+    n = x.shape[0]
+    weights = np.ones(n, dtype=np.float32)
+    if mesh is not None and "data" not in mesh.axis_names:
+        mesh = None  # custom-axis mesh: train unsharded rather than crash
+    if mesh is not None:
+        from predictionio_tpu.parallel.mesh import replicated, shard_rows
+
+        # zero-weight padding rows keep the weighted mean exact when n does
+        # not divide the data axis
+        x_j, y_j, w_j = shard_rows(
+            mesh,
+            np.asarray(x, np.float32),
+            np.asarray(y),
+            weights,
+        )
+        rep = replicated(mesh)
+        put_params = lambda p: jax.device_put(p, rep)
+    else:
+        x_j = jnp.asarray(x)
+        y_j = jnp.asarray(y)
+        w_j = jnp.asarray(weights)
+        put_params = lambda p: p
     dim = x.shape[1]
-    params = {
+    params = put_params({
         "w": jnp.zeros((dim, num_classes), dtype=jnp.float32),
         "b": jnp.zeros((num_classes,), dtype=jnp.float32),
-    }
+    })
 
     def loss_fn(p):
         logits = x_j @ p["w"] + p["b"]
-        nll = optax.softmax_cross_entropy_with_integer_labels(logits, y_j).mean()
+        nll = optax.softmax_cross_entropy_with_integer_labels(logits, y_j)
+        nll = (nll * w_j).sum() / w_j.sum()
         return nll + reg * (p["w"] ** 2).sum()
 
     if hasattr(optax, "lbfgs"):

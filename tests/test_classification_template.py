@@ -69,6 +69,23 @@ class TestKernels:
         acc = (m.scores(x).argmax(axis=1) == y).mean()
         assert acc > 0.95
 
+    def test_logreg_sharded_matches_single_device(self):
+        """dp over the 8-device mesh (examples sharded, params replicated,
+        psum-reduced grads) must train the same model as one device --
+        including when the row count does not divide the mesh (zero-weight
+        padding keeps the weighted mean exact)."""
+        from predictionio_tpu.parallel.mesh import local_mesh
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(203, 4)).astype(np.float32)  # 203 % 8 != 0
+        y = (x[:, 0] - x[:, 2] > 0).astype(np.int32)
+        m1 = train_logistic_regression(x, y, 2, iterations=40)
+        m8 = train_logistic_regression(
+            x, y, 2, iterations=40, mesh=local_mesh(8, 1)
+        )
+        np.testing.assert_allclose(m1.weights, m8.weights, rtol=2e-3, atol=2e-4)
+        np.testing.assert_allclose(m1.bias, m8.bias, rtol=2e-3, atol=2e-4)
+
 
 class TestClassificationEngine:
     @pytest.mark.parametrize("algo", ["naive-bayes", "logistic-regression"])
