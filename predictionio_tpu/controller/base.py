@@ -150,6 +150,23 @@ class TPUAlgorithm(Algorithm[PD, M, Q, P]):
     an ICI mesh".
     """
 
+    @staticmethod
+    def mesh_or_none(ctx):
+        """``ctx.mesh``, degrading to None (unsharded training) when mesh
+        construction fails -- with the failure logged, not swallowed: a
+        misconfigured pod coordinator should not silently train on one
+        host. The common benign case is a context with no devices at all
+        (pure-host tests)."""
+        import logging
+
+        try:
+            return ctx.mesh
+        except Exception:
+            logging.getLogger("pio.controller").warning(
+                "mesh unavailable; training unsharded", exc_info=True
+            )
+            return None
+
 
 class Serving(Component, Generic[Q, P]):
     @abc.abstractmethod

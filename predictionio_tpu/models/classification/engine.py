@@ -207,8 +207,13 @@ class NaiveBayesAlgorithm(_ClassifierBase):
 
     def train(self, ctx, prepared) -> ClassifierModel:
         space, x, y = prepared
+        mesh = self.mesh_or_none(ctx)  # dp over examples
         model = train_naive_bayes(
-            x, y, len(space.classes), smoothing=self.params.get_or("smoothing", 1.0)
+            x,
+            y,
+            len(space.classes),
+            smoothing=self.params.get_or("smoothing", 1.0),
+            mesh=mesh,
         )
         return ClassifierModel(space=space, inner=model)
 
@@ -218,10 +223,7 @@ class LogisticRegressionAlgorithm(_ClassifierBase):
 
     def train(self, ctx, prepared) -> ClassifierModel:
         space, x, y = prepared
-        try:
-            mesh = ctx.mesh  # dp over examples; see train_logistic_regression
-        except Exception:
-            mesh = None  # no devices available (pure-host tests)
+        mesh = self.mesh_or_none(ctx)  # dp over examples
         model = train_logistic_regression(
             x,
             y,

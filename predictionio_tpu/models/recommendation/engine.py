@@ -182,11 +182,7 @@ class ALSAlgorithm(TPUAlgorithm):
     def train(self, ctx, prepared) -> RecommendationModel:
         ratings_data, als_data = prepared
         config = self._config()
-        mesh = None
-        try:
-            mesh = ctx.mesh
-        except Exception:
-            mesh = None
+        mesh = self.mesh_or_none(ctx)
         interval = self.params.get_or("checkpointInterval", 5)
         checkpoint = ctx.checkpoint_manager("als") if interval > 0 else None
         init, start_iteration, callback = None, 0, None

@@ -31,8 +31,20 @@ class NaiveBayesModel:
 
 
 def train_naive_bayes(
-    x: np.ndarray, y: np.ndarray, num_classes: int, smoothing: float = 1.0
+    x: np.ndarray,
+    y: np.ndarray,
+    num_classes: int,
+    smoothing: float = 1.0,
+    mesh=None,
 ) -> NaiveBayesModel:
+    """Multinomial NB: the count matrix is ONE matmul.
+
+    With ``mesh``, examples shard over the ``data`` axis (zero-weight
+    padding rows are masked out of the one-hot, so they contribute no
+    counts) and the count matmul's cross-example reduction becomes an
+    XLA-inserted psum -- MLlib NaiveBayes' per-partition aggregate+combine,
+    as GSPMD sharding.
+    """
     # multinomial NB is defined over counts; negative features would poison
     # the log with NaNs (MLlib's NaiveBayes rejects them the same way)
     if np.min(x) < 0:
@@ -40,13 +52,18 @@ def train_naive_bayes(
             "NaiveBayes requires non-negative features (multinomial counts);"
             " use logistic-regression for signed features"
         )
+    from predictionio_tpu.parallel.mesh import shard_examples
+
+    x_j, y_j, w_j, mesh = shard_examples(mesh, x, y)
+
     @jax.jit
-    def _fit(x, y):
+    def _fit(x, y, w):
         onehot = jax.nn.one_hot(y, num_classes, dtype=x.dtype)       # [n, C]
+        onehot = onehot * w[:, None]        # padding rows count nothing
         counts = onehot.T @ x                                        # [C, D] one MXU pass
         class_counts = onehot.sum(axis=0)                            # [C]
         log_prior = jnp.log(class_counts + smoothing) - jnp.log(
-            y.shape[0] + num_classes * smoothing
+            w.sum() + num_classes * smoothing
         )
         smoothed = counts + smoothing
         log_likelihood = jnp.log(smoothed) - jnp.log(
@@ -54,7 +71,7 @@ def train_naive_bayes(
         )
         return log_prior, log_likelihood
 
-    log_prior, log_likelihood = _fit(jnp.asarray(x), jnp.asarray(y))
+    log_prior, log_likelihood = _fit(x_j, y_j, w_j)
     return NaiveBayesModel(np.asarray(log_prior), np.asarray(log_likelihood))
 
 
@@ -86,27 +103,13 @@ def train_logistic_regression(
     XLA-inserted psums over ICI -- the Spark-executor data parallelism of
     MLlib's LogisticRegressionWithLBFGS, rebuilt as GSPMD sharding.
     """
-    n = x.shape[0]
-    weights = np.ones(n, dtype=np.float32)
-    if mesh is not None and "data" not in mesh.axis_names:
-        mesh = None  # custom-axis mesh: train unsharded rather than crash
-    if mesh is not None:
-        from predictionio_tpu.parallel.mesh import replicated, shard_rows
+    from predictionio_tpu.parallel.mesh import replicated, shard_examples
 
-        # zero-weight padding rows keep the weighted mean exact when n does
-        # not divide the data axis
-        x_j, y_j, w_j = shard_rows(
-            mesh,
-            np.asarray(x, np.float32),
-            np.asarray(y),
-            weights,
-        )
+    x_j, y_j, w_j, mesh = shard_examples(mesh, x, y)
+    if mesh is not None:
         rep = replicated(mesh)
         put_params = lambda p: jax.device_put(p, rep)
     else:
-        x_j = jnp.asarray(x)
-        y_j = jnp.asarray(y)
-        w_j = jnp.asarray(weights)
         put_params = lambda p: p
     dim = x.shape[1]
     params = put_params({

@@ -29,6 +29,28 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def shard_examples(mesh: Mesh | None, x, y):
+    """Shared dp entry for the full-batch trainers (NB, LogReg).
+
+    Returns ``(x_j, y_j, w_j, mesh)``: examples row-sharded over ``data``
+    with zero-weight padding rows (so weighted means and masked counts stay
+    exact when n does not divide the axis), or plain host arrays --
+    ``mesh`` comes back None -- when no mesh was given or it has no
+    ``data`` axis (custom-axis configs train unsharded rather than crash).
+    """
+    import jax.numpy as jnp
+
+    weights = np.ones(np.asarray(x).shape[0], dtype=np.float32)
+    if mesh is not None and "data" not in mesh.axis_names:
+        mesh = None
+    if mesh is None:
+        return jnp.asarray(x), jnp.asarray(y), jnp.asarray(weights), None
+    x_j, y_j, w_j = shard_rows(
+        mesh, np.asarray(x, np.float32), np.asarray(y), weights
+    )
+    return x_j, y_j, w_j, mesh
+
+
 def check_steps_ran(steps: int, n_examples: int, data_axis_size: int, what: str):
     """Raise when a training loop completed without a single step: the data
     can't fill even one batch across the data axis (shared guard for the

@@ -69,6 +69,19 @@ class TestKernels:
         acc = (m.scores(x).argmax(axis=1) == y).mean()
         assert acc > 0.95
 
+    def test_naive_bayes_sharded_matches_single_device(self):
+        """Sharded counts (masked one-hot + psum matmul) must reproduce the
+        single-device model exactly, padding included."""
+        from predictionio_tpu.parallel.mesh import local_mesh
+
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, 5, size=(101, 7)).astype(np.float32)  # 101 % 8 != 0
+        y = rng.integers(0, 3, size=101).astype(np.int32)
+        m1 = train_naive_bayes(x, y, 3)
+        m8 = train_naive_bayes(x, y, 3, mesh=local_mesh(8, 1))
+        np.testing.assert_allclose(m1.log_prior, m8.log_prior, rtol=1e-6)
+        np.testing.assert_allclose(m1.log_likelihood, m8.log_likelihood, rtol=1e-6)
+
     def test_logreg_sharded_matches_single_device(self):
         """dp over the 8-device mesh (examples sharded, params replicated,
         psum-reduced grads) must train the same model as one device --
