@@ -120,7 +120,11 @@ class CooccurrenceAlgorithm(TPUAlgorithm):
             times=data.times,
             max_len=self.params.get_or("maxEventsPerUser", None),
         )
-        cooc = cooccurrence(csr, chunk=self.params.get_or("chunk", 4096))
+        cooc = cooccurrence(
+            csr,
+            chunk=self.params.get_or("chunk", 4096),
+            mesh=self.mesh_or_none(ctx),  # user rows dp-sharded, psum acc
+        )
         if self.params.get_or("llr", True):
             totals = np.diag(cooc).copy()
             matrix = llr_scores(cooc, totals, totals, total=len(data.user_ids))

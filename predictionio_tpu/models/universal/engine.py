@@ -161,6 +161,7 @@ class URAlgorithm(TPUAlgorithm):
         max_len = self.params.get_or("maxEventsPerUser", None)
         chunk = self.params.get_or("chunk", 4096)
         top_k = self.params.get_or("topK", 50)
+        mesh = self.mesh_or_none(ctx)  # user rows dp-sharded, psum acc
 
         def to_csr(triples):
             uu, ii, tt = triples
@@ -181,7 +182,7 @@ class URAlgorithm(TPUAlgorithm):
             csr = primary_csr if name == data.event_names[0] else to_csr(
                 data.per_event[name]
             )
-            cooc = cooccurrence(primary_csr, csr, chunk=chunk)
+            cooc = cooccurrence(primary_csr, csr, chunk=chunk, mesh=mesh)
             col_counts = (
                 primary_counts
                 if name == data.event_names[0]

@@ -23,15 +23,21 @@ import numpy as np
 from predictionio_tpu.ops.ragged import PaddedCSR
 
 
+def _dense_onehot(indices, mask, num_cols: int):
+    """Binarized dense [rows, num_cols] from padded-CSR rows (jittable;
+    scatter-add then clamp, sentinel column dropped) -- the ONE definition
+    both the host-streamed and mesh paths build their matmuls from."""
+    rows = indices.shape[0]
+    row_ids = jnp.repeat(jnp.arange(rows), indices.shape[1])
+    out = jnp.zeros((rows, num_cols + 1), dtype=jnp.float32)
+    out = out.at[row_ids, indices.reshape(-1)].add(mask.reshape(-1))
+    return jnp.minimum(out[:, :num_cols], 1.0)
+
+
 @functools.partial(jax.jit, static_argnames=("num_cols",), donate_argnums=(3,))
 def _accumulate_chunk(indices, mask, other_onehot, acc, *, num_cols):
     """acc += onehot(indices)^T @ other_onehot for one user chunk."""
-    chunk = indices.shape[0]
-    rows = jnp.repeat(jnp.arange(chunk), indices.shape[1])
-    onehot = jnp.zeros((chunk, num_cols + 1), dtype=jnp.float32)
-    onehot = onehot.at[rows, indices.reshape(-1)].add(mask.reshape(-1))
-    onehot = jnp.minimum(onehot[:, :num_cols], 1.0)  # binarize; drop sentinel
-    return acc + onehot.T @ other_onehot
+    return acc + _dense_onehot(indices, mask, num_cols).T @ other_onehot
 
 
 def _onehot_chunk(csr: PaddedCSR, start: int, end: int) -> np.ndarray:
@@ -46,18 +52,30 @@ def _onehot_chunk(csr: PaddedCSR, start: int, end: int) -> np.ndarray:
 
 
 def cooccurrence(
-    primary: PaddedCSR, other: PaddedCSR | None = None, chunk: int = 4096
+    primary: PaddedCSR,
+    other: PaddedCSR | None = None,
+    chunk: int = 4096,
+    mesh=None,
 ) -> np.ndarray:
     """``A_primary^T @ A_other`` over shared user rows -> [items_p, items_o].
 
     ``other=None`` means self-cooccurrence. Both CSRs must be row-indexed by
-    the same user universe (same num_rows).
+    the same user universe (same num_rows). With ``mesh``, user rows shard
+    over the ``data`` axis: each device accumulates its local users'
+    contribution (scanning fixed-size chunks so the dense one-hot buffers
+    stay bounded) and one final ``psum`` combines the per-device
+    ``[items_p, items_o]`` partials over ICI -- the Spark-shuffle
+    aggregation of the reference's cooccurrence jobs as a single collective.
     """
     other = other if other is not None else primary
     if primary.num_rows != other.num_rows:
         raise ValueError(
             f"CSRs must share the user universe: {primary.num_rows} vs {other.num_rows}"
         )
+    if mesh is not None and "data" not in mesh.axis_names:
+        mesh = None  # custom-axis mesh: run the host-streamed path
+    if mesh is not None and mesh.shape["data"] > 1:
+        return _cooccurrence_mesh(primary, other, chunk, mesh)
     n_users = primary.num_rows
     acc = jnp.zeros((primary.num_cols, other.num_cols), dtype=jnp.float32)
     for start in range(0, n_users, chunk):
@@ -70,6 +88,85 @@ def cooccurrence(
             num_cols=primary.num_cols,
         )
     return np.asarray(acc)
+
+
+def _pad_rows_sentinel(csr: PaddedCSR, rows: int) -> tuple[np.ndarray, np.ndarray]:
+    """(indices, mask) grown to ``rows`` rows; padding rows carry the
+    sentinel column with mask 0, so they contribute nothing."""
+    pad = rows - csr.indices.shape[0]
+    indices = np.pad(csr.indices, ((0, pad), (0, 0)), constant_values=csr.num_cols)
+    mask = np.pad(csr.mask, ((0, pad), (0, 0)))
+    return indices, mask
+
+
+def _cooccurrence_mesh(
+    primary: PaddedCSR, other: PaddedCSR, chunk: int, mesh
+) -> np.ndarray:
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    data_size = int(mesh.shape["data"])
+    # base row math on the PHYSICAL (row_multiple-padded) CSR rows, not
+    # num_rows: pack_padded_csr rounds rows up, and a target below the
+    # physical count would make _pad_rows_sentinel's pad width negative
+    phys_rows = max(primary.indices.shape[0], other.indices.shape[0])
+    per_device = -(-phys_rows // data_size)
+    chunk = max(1, min(chunk, per_device))
+    # every device scans the same number of fixed-size chunks: pad the user
+    # universe so rows = data * chunks_per_device * chunk
+    chunks_per_device = -(-per_device // chunk)
+    rows = data_size * chunks_per_device * chunk
+    idx_p, msk_p = _pad_rows_sentinel(primary, rows)
+    if other is primary:  # self-cooccurrence: don't build/ship a second copy
+        idx_o, msk_o = idx_p, msk_p
+    else:
+        idx_o, msk_o = _pad_rows_sentinel(other, rows)
+    num_p, num_o = primary.num_cols, other.num_cols
+
+    def local(idx_p, msk_p, idx_o, msk_o):
+        local_rows = idx_p.shape[0]
+        n_chunks = local_rows // chunk
+
+        def body(acc, args):
+            i_p, m_p, i_o, m_o = args
+            return (
+                acc
+                + _dense_onehot(i_p, m_p, num_p).T
+                @ _dense_onehot(i_o, m_o, num_o),
+                None,
+            )
+
+        def split(a):
+            return a.reshape(n_chunks, chunk, a.shape[1])
+
+        # fresh constants are "unvarying" under shard_map's vma tracking;
+        # the scan carry must match the (varying) body output type
+        acc0 = jax.lax.pcast(
+            jnp.zeros((num_p, num_o), dtype=jnp.float32), "data", to="varying"
+        )
+        acc, _ = jax.lax.scan(
+            body, acc0, (split(idx_p), split(msk_p), split(idx_o), split(msk_o))
+        )
+        return jax.lax.psum(acc, "data")
+
+    row = PartitionSpec("data")
+    rep = PartitionSpec()
+    fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(row, row, row, row),
+            out_specs=rep,
+        )
+    )
+    sharding = NamedSharding(mesh, row)
+    return np.asarray(
+        fn(
+            jax.device_put(idx_p, sharding),
+            jax.device_put(msk_p, sharding),
+            jax.device_put(idx_o, sharding),
+            jax.device_put(msk_o, sharding),
+        )
+    )
 
 
 def distinct_user_counts(csr: PaddedCSR) -> np.ndarray:

@@ -26,6 +26,46 @@ class TestCooccurrenceKernels:
         got = cooccurrence(csr, chunk=16)
         np.testing.assert_allclose(got, dense.T @ dense, atol=1e-4)
 
+    def test_cooccurrence_sharded_matches_host_path(self):
+        """dp over the 8-device mesh (user rows sharded, per-device scan
+        chunks, one psum of the [P, O] partials) must equal the
+        host-streamed path exactly -- including self- and cross-occurrence,
+        a row count that does not divide the mesh, and a chunk smaller
+        than the per-device rows."""
+        from predictionio_tpu.parallel.mesh import local_mesh
+
+        rng = np.random.default_rng(3)
+        n_u, n_i = 77, 9  # 77 % 8 != 0
+        dense_a = (rng.random((n_u, n_i)) < 0.3).astype(np.float32)
+        dense_b = (rng.random((n_u, n_i)) < 0.2).astype(np.float32)
+        ua, ia = np.nonzero(dense_a)
+        ub, ib = np.nonzero(dense_b)
+        a = pack_padded_csr(ua, ia, np.ones(len(ua), np.float32), n_u, n_i)
+        b = pack_padded_csr(ub, ib, np.ones(len(ub), np.float32), n_u, n_i)
+        mesh = local_mesh(8, 1)
+        np.testing.assert_allclose(
+            cooccurrence(a, mesh=mesh, chunk=4),
+            cooccurrence(a),
+            atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            cooccurrence(a, b, mesh=mesh, chunk=4),
+            cooccurrence(a, b),
+            atol=1e-4,
+        )
+        # regression: physical (lane-padded) rows exceed the mesh-derived
+        # row target -- 100 users pad to 104 physical rows, and a 4-way
+        # mesh must size its shards from 104, not 100
+        n_u = 100
+        dense_c = (rng.random((n_u, n_i)) < 0.3).astype(np.float32)
+        uc, ic = np.nonzero(dense_c)
+        c = pack_padded_csr(uc, ic, np.ones(len(uc), np.float32), n_u, n_i)
+        np.testing.assert_allclose(
+            cooccurrence(c, mesh=local_mesh(4, 1)),
+            cooccurrence(c),
+            atol=1e-4,
+        )
+
     def test_cross_occurrence(self):
         # users 0,1 buy item 0; users 0,1,2 view item 1 -> cooc[0,1] = 2
         buy = pack_padded_csr(np.array([0, 1]), np.array([0, 0]),
