@@ -168,6 +168,12 @@ class StorageClient(sql_common.SQLStorageClient):
         "INSERT INTO models (id, models) VALUES (?, ?)"
         " ON DUPLICATE KEY UPDATE models = VALUES(models)"
     )
+    # MySQL's JSON_TYPE vocabulary is uppercase and splits the numeric kinds
+    JSON_NUMBER_EXPR = (
+        "CASE WHEN JSON_TYPE(JSON_EXTRACT(properties, ?)) IN"
+        " ('INTEGER', 'DOUBLE', 'DECIMAL', 'UNSIGNED INTEGER')"
+        " THEN JSON_EXTRACT(properties, ?) END"
+    )
 
     def __init__(self, config: StorageClientConfig):
         super().__init__(config)

@@ -137,6 +137,16 @@ class StorageClient(sql_common.SQLStorageClient):
         "INSERT INTO models (id, models) VALUES (?, ?)"
         " ON CONFLICT (id) DO UPDATE SET models = EXCLUDED.models"
     )
+    # properties is TEXT holding JSON; -> / ->> want jsonb and a bare key.
+    # jsonb_typeof gate keeps string/bool ratings NULL (from_events parity)
+    JSON_NUMBER_EXPR = (
+        "CASE WHEN jsonb_typeof(properties::jsonb -> ?) = 'number'"
+        " THEN (properties::jsonb ->> ?) END"
+    )
+
+    @classmethod
+    def json_number_params(cls, key: str) -> tuple:
+        return (key, key)
 
     def __init__(self, config: StorageClientConfig):
         super().__init__(config)

@@ -149,6 +149,28 @@ class SQLStorageClient(base.BaseStorageClient):
     )
     #: upsert into models(id, models)
     UPSERT_MODEL = "INSERT OR REPLACE INTO models (id, models) VALUES (?, ?)"
+    #: dialect JSON extraction over the properties column, NUMBERS ONLY --
+    #: NULL for strings/bools/objects, matching EventDataset.from_events'
+    #: isinstance(int|float)-and-not-bool rating rule exactly. Placeholders
+    #: bind to :meth:`json_number_params` in order. (sqlite form here;
+    #: postgres/mysql override.)
+    JSON_NUMBER_EXPR = (
+        "CASE WHEN json_type(properties, ?) IN ('integer', 'real')"
+        " THEN json_extract(properties, ?) END"
+    )
+
+    @classmethod
+    def json_number_params(cls, key: str) -> tuple:
+        """Bind values for JSON_NUMBER_EXPR's placeholders, in order."""
+        path = cls._json_path(key)
+        return (path, path)
+
+    @staticmethod
+    def _json_path(key: str) -> str:
+        # JSON-path escaping is backslash-style (doubling quotes is SQL
+        # string escaping and silently matches nothing in sqlite)
+        escaped = key.replace("\\", "\\\\").replace('"', '\\"')
+        return f'$."{escaped}"'
 
     def sql(self, statement: str) -> str:
         if self.placeholder == "?":
@@ -659,10 +681,11 @@ class SQLLEvents(base.LEvents):
         )
         return cur.rowcount > 0
 
-    def find(
-        self,
-        app_id: int,
-        channel_id: int | None = None,
+    @staticmethod
+    def _append_filters(
+        sql: list,
+        params: list,
+        *,
         start_time: _dt.datetime | None = None,
         until_time: _dt.datetime | None = None,
         entity_type: str | None = None,
@@ -670,13 +693,10 @@ class SQLLEvents(base.LEvents):
         event_names: list[str] | None = None,
         target_entity_type=...,
         target_entity_id=...,
-        limit: int | None = None,
-        reversed: bool = False,
-    ) -> Iterator[Event]:
-        sql = [
-            f"SELECT {self._EVENT_COLS} FROM events WHERE app_id=? AND channel_id=?"
-        ]
-        params: list = [app_id, self._ch(channel_id)]
+    ) -> None:
+        """WHERE-clause builder shared by find() and scan_interactions():
+        one definition so the row and columnar paths cannot desynchronize
+        their filter semantics."""
         if start_time is not None:
             sql.append("AND event_time_ms >= ?")
             params.append(ts_ms(start_time))
@@ -704,6 +724,36 @@ class SQLLEvents(base.LEvents):
             else:
                 sql.append("AND target_entity_id = ?")
                 params.append(target_entity_id)
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: list[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        sql = [
+            f"SELECT {self._EVENT_COLS} FROM events WHERE app_id=? AND channel_id=?"
+        ]
+        params: list = [app_id, self._ch(channel_id)]
+        self._append_filters(
+            sql,
+            params,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
         sql.append(f"ORDER BY event_time_ms {'DESC' if reversed else 'ASC'}")
         if limit is not None and limit >= 0:
             sql.append("LIMIT ?")
@@ -715,3 +765,60 @@ class SQLLEvents(base.LEvents):
         runner = self.c.query if small else self.c.query_iter
         for r in runner(self.c.sql(" ".join(sql)), tuple(params)):
             yield self._row_to_event(r)
+
+    def scan_interactions(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        event_names: list[str] | None = None,
+        target_entity_type=...,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        rating_key: str = "rating",
+    ):
+        """Columnar training scan: the dataset-builder's fast path.
+
+        Returns ``(entity_ids, target_entity_ids, event_names,
+        event_times_iso, ratings_raw)`` -- five python lists -- WITHOUT
+        constructing an Event (or json-parsing properties) per row: the
+        rating is extracted server-side via the dialect's numbers-only JSON
+        expression, so string/bool ratings come back NULL exactly like the
+        row path's isinstance check. ``event_times_iso`` carries the stored
+        ISO8601 strings (full microsecond precision; event_time_ms would
+        truncate sub-ms ordering the row path preserves). Same time
+        ordering as ``find`` (event_time_ms ASC). At ML-20M scale this is
+        the difference between seconds and minutes of ``pio train`` read
+        time.
+        """
+        select = (
+            "SELECT entity_id, target_entity_id, event, event_time,"
+            f" {self.c.JSON_NUMBER_EXPR} FROM events"
+        )
+        sql = [select, "WHERE app_id=? AND channel_id=?"]
+        # the JSON expr's placeholders appear FIRST in the statement
+        params: list = [
+            *self.c.json_number_params(rating_key),
+            app_id,
+            self._ch(channel_id),
+        ]
+        self._append_filters(
+            sql,
+            params,
+            start_time=start_time,
+            until_time=until_time,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+        )
+        sql.append("ORDER BY event_time_ms ASC")
+        ents: list = []
+        tgts: list = []
+        names: list = []
+        times: list = []
+        ratings: list = []
+        for r in self.c.query_iter(self.c.sql(" ".join(sql)), tuple(params)):
+            ents.append(r[0])
+            tgts.append(r[1])
+            names.append(r[2])
+            times.append(r[3])
+            ratings.append(r[4])
+        return ents, tgts, names, times, ratings

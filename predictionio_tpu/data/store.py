@@ -56,7 +56,9 @@ class EventDataset:
     String-valued columns are dictionary-encoded: ``entity_ids[i]`` indexes
     into ``entity_id_vocab``. Numeric columns are dense numpy arrays, ready
     to shard onto a device mesh. ``events`` retains the row objects for
-    host-side logic that needs full fidelity (properties etc.).
+    host-side logic that needs full fidelity (properties etc.) -- it is
+    EMPTY when the dataset came through a backend's columnar fast scan
+    (``from_columns``), which skips Event construction entirely.
     """
 
     events: list[Event]
@@ -70,7 +72,7 @@ class EventDataset:
     ratings: np.ndarray           # float32 [n], properties["rating"] or NaN
 
     def __len__(self) -> int:
-        return len(self.events)
+        return int(self.entity_ids.size)
 
     @classmethod
     def from_events(cls, events: list[Event], rating_key: str = "rating") -> "EventDataset":
@@ -101,6 +103,81 @@ class EventDataset:
             target_entity_ids=tgt,
             event_names=names,
             event_times=times,
+            ratings=ratings,
+        )
+
+    @classmethod
+    def from_columns(
+        cls, entity_ids, target_entity_ids, event_names, event_times_iso, ratings_raw
+    ) -> "EventDataset":
+        """Build from a backend columnar scan (``scan_interactions``) --
+        no Event objects, no per-row JSON parse. Matches ``from_events``
+        output exactly: first-appearance vocabulary order (None targets ->
+        the -1 sentinel), microsecond-precision timestamps from the stored
+        ISO strings, and ratings pre-filtered to JSON numbers by the
+        backend. pandas accelerates the encoding when present (it is not a
+        declared dependency); pure-python fallbacks match it bit-for-bit.
+        """
+        try:
+            import pandas as pd
+        except ImportError:
+            pd = None
+
+        def encode(values) -> tuple[np.ndarray, list[str]]:
+            if pd is not None:
+                codes, vocab = pd.factorize(np.asarray(values, dtype=object))
+                return codes.astype(np.int32), [str(v) for v in vocab]
+            vocab_map: dict[str, int] = {}
+            codes = np.empty(len(values), dtype=np.int32)
+            for i, v in enumerate(values):
+                codes[i] = (
+                    -1 if v is None else vocab_map.setdefault(v, len(vocab_map))
+                )
+            return codes, list(vocab_map)
+
+        ent, ent_vocab = encode(entity_ids)
+        tgt, tgt_vocab = encode(target_entity_ids)
+        names, name_vocab = encode(event_names)
+
+        n = len(entity_ids)
+        if pd is not None:
+            # as_unit("ns"): pandas 2 may parse into us/ms resolution, and
+            # asi8 reports in whatever unit the index landed in
+            times = (
+                pd.DatetimeIndex(
+                    pd.to_datetime(event_times_iso, utc=True, format="ISO8601")
+                )
+                .as_unit("ns")
+                .asi8
+                / 1e9
+            )
+        else:
+            times = np.fromiter(
+                (_dt.datetime.fromisoformat(s).timestamp() for s in event_times_iso),
+                dtype=np.float64,
+                count=n,
+            )
+
+        def to_float(v) -> float:
+            if v is None:
+                return np.nan
+            try:
+                return float(v)  # drivers may hand numbers back as str/Decimal
+            except (TypeError, ValueError):
+                return np.nan
+
+        ratings = np.fromiter(
+            (to_float(v) for v in ratings_raw), dtype=np.float32, count=n
+        )
+        return cls(
+            events=[],
+            entity_id_vocab=ent_vocab,
+            target_entity_id_vocab=tgt_vocab,
+            event_name_vocab=name_vocab,
+            entity_ids=ent,
+            target_entity_ids=tgt,
+            event_names=names,
+            event_times=np.asarray(times, np.float64),
             ratings=ratings,
         )
 
@@ -184,12 +261,33 @@ class PEventStore:
             )
         )
 
+    #: dataset() filters the columnar fast scan understands; anything else
+    #: (entity filters, exotic target matching) falls back to the row path
+    _FAST_SCAN_FILTERS = frozenset(
+        {"event_names", "target_entity_type", "start_time", "until_time"}
+    )
+
     @staticmethod
     def dataset(
-        app_name: str, rating_key: str = "rating", **kwargs
+        app_name: str,
+        rating_key: str = "rating",
+        channel_name: str | None = None,
+        **kwargs,
     ) -> EventDataset:
+        le = storage_registry.get_l_events()
+        if (
+            hasattr(le, "scan_interactions")
+            and set(kwargs) <= PEventStore._FAST_SCAN_FILTERS
+        ):
+            app_id, channel_id = resolve_app_channel(app_name, channel_name)
+            return EventDataset.from_columns(
+                *le.scan_interactions(
+                    app_id, channel_id, rating_key=rating_key, **kwargs
+                )
+            )
         return EventDataset.from_events(
-            PEventStore.find(app_name, **kwargs), rating_key=rating_key
+            PEventStore.find(app_name, channel_name=channel_name, **kwargs),
+            rating_key=rating_key,
         )
 
     @staticmethod

@@ -206,3 +206,55 @@ class TestStoreFacades:
         assert ds.entity_id_vocab == ["u1", "u2"]
         assert ds.target_entity_id_vocab == ["i1"]
         assert list(ds.ratings) == [4.0, 3.0]
+
+    def test_dataset_fast_scan_matches_row_path(self, storage_env):
+        """SQL backends build datasets through the columnar fast scan (no
+        Event per row); it must produce exactly what from_events does --
+        same vocab first-appearance order, -1 sentinel for absent targets,
+        NaN for absent ratings, same time ordering."""
+        import numpy as np
+
+        from predictionio_tpu.data.store import EventDataset, PEventStore
+
+        apps = storage_env.get_meta_data_apps()
+        apps.insert(App(name="FastScan"))
+        le = storage_env.get_l_events()
+        app_id = apps.get_by_name("FastScan").id
+        le.init_channel(app_id)
+        import dataclasses
+
+        sub_ms = mk_event(5, name="rate", eid="u9", tid="i2", props={"rating": 2})
+        sub_ms = dataclasses.replace(
+            sub_ms, event_time=sub_ms.event_time.replace(microsecond=123456)
+        )
+        le.batch_insert(
+            [
+                mk_event(0, name="rate", eid="u3", tid="i9", props={"rating": 5.0}),
+                mk_event(1, name="view", eid="u1", tid="i2"),
+                mk_event(2, name="rate", eid="u3", tid="i2", props={"rating": 1.5}),
+                mk_event(3, name="$set", eid="u1", props={"vip": True}),
+                mk_event(4, name="rate", eid="u2", tid="i9", props={"other": 1}),
+                # from_events accepts only real JSON numbers as ratings: the
+                # string "4.5" and true must come back NaN from BOTH paths,
+                # and the microsecond timestamp must survive exactly
+                mk_event(6, name="rate", eid="u2", tid="i2", props={"rating": "4.5"}),
+                mk_event(7, name="rate", eid="u1", tid="i9", props={"rating": True}),
+                sub_ms,
+            ],
+            app_id=app_id,
+        )
+        fast = PEventStore.dataset("FastScan")
+        slow = EventDataset.from_events(
+            PEventStore.find("FastScan"), rating_key="rating"
+        )
+        assert fast.entity_id_vocab == slow.entity_id_vocab
+        assert fast.target_entity_id_vocab == slow.target_entity_id_vocab
+        assert fast.event_name_vocab == slow.event_name_vocab
+        np.testing.assert_array_equal(fast.entity_ids, slow.entity_ids)
+        np.testing.assert_array_equal(fast.target_entity_ids, slow.target_entity_ids)
+        np.testing.assert_array_equal(fast.event_names, slow.event_names)
+        np.testing.assert_allclose(fast.event_times, slow.event_times)
+        np.testing.assert_allclose(fast.ratings, slow.ratings)
+        # unsupported filters (entity_id) transparently use the row path
+        filtered = PEventStore.dataset("FastScan", entity_id="u3")
+        assert len(filtered) == 2 and len(filtered.events) == 2
