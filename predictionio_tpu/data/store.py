@@ -140,18 +140,24 @@ class EventDataset:
         names, name_vocab = encode(event_names)
 
         n = len(entity_ids)
+        times = None
         if pd is not None:
-            # as_unit("ns"): pandas 2 may parse into us/ms resolution, and
-            # asi8 reports in whatever unit the index landed in
-            times = (
-                pd.DatetimeIndex(
-                    pd.to_datetime(event_times_iso, utc=True, format="ISO8601")
+            try:
+                # as_unit("ns"): pandas 2 may parse into us/ms resolution,
+                # and asi8 reports in whatever unit the index landed in.
+                # format="ISO8601" and as_unit are pandas>=2 API -- any
+                # older-pandas failure drops to the stdlib loop below
+                times = (
+                    pd.DatetimeIndex(
+                        pd.to_datetime(event_times_iso, utc=True, format="ISO8601")
+                    )
+                    .as_unit("ns")
+                    .asi8
+                    / 1e9
                 )
-                .as_unit("ns")
-                .asi8
-                / 1e9
-            )
-        else:
+            except Exception:
+                times = None
+        if times is None:
             times = np.fromiter(
                 (_dt.datetime.fromisoformat(s).timestamp() for s in event_times_iso),
                 dtype=np.float64,
@@ -280,11 +286,25 @@ class PEventStore:
             and set(kwargs) <= PEventStore._FAST_SCAN_FILTERS
         ):
             app_id, channel_id = resolve_app_channel(app_name, channel_name)
-            return EventDataset.from_columns(
-                *le.scan_interactions(
-                    app_id, channel_id, rating_key=rating_key, **kwargs
+            try:
+                return EventDataset.from_columns(
+                    *le.scan_interactions(
+                        app_id, channel_id, rating_key=rating_key, **kwargs
+                    )
                 )
-            )
+            except Exception:
+                # e.g. a stored properties blob the DB's JSON functions
+                # reject (python's json accepts NaN, SQL JSON does not):
+                # the row path parses it fine, so degrade instead of
+                # failing training for the whole app
+                import logging
+
+                logging.getLogger("pio.store").warning(
+                    "columnar fast scan failed for app %r; falling back to"
+                    " the row path",
+                    app_name,
+                    exc_info=True,
+                )
         return EventDataset.from_events(
             PEventStore.find(app_name, channel_name=channel_name, **kwargs),
             rating_key=rating_key,

@@ -274,11 +274,53 @@ class ALSAlgorithm(TPUAlgorithm):
             return self._similar_items(model, query, num)
         raise ValueError("query must contain 'user' or 'items'")
 
-    def _recommend_for_user(self, model: RecommendationModel, query, num: int) -> dict:
-        user_idx = model.user_index.get(str(query["user"]))
-        if user_idx is None:
-            return {"itemScores": []}  # cold user: reference returns empty
-        scores = model.als.score_items_for_user(user_idx)
+    def batch_predict(self, model: RecommendationModel, queries):
+        """Vectorized bulk scoring: all known-user recommendation queries in
+        one chunk score as a SINGLE [B, K] @ [K, items] matmul instead of B
+        gemvs + python per query (the reference's P2LAlgorithm.batchPredict
+        parallelism, as one MXU-shaped product). Cold users, item-similarity
+        queries, and malformed queries fall back to predict()."""
+        user_rows = []  # (qid, query, user_idx)
+        fallback = []
+        for qid, q in queries:
+            user_idx = (
+                model.user_index.get(str(q["user"]))
+                if isinstance(q, dict) and "user" in q
+                else None
+            )
+            if user_idx is None:
+                fallback.append((qid, q))
+            else:
+                user_rows.append((qid, q, user_idx))
+        out = []
+        if user_rows:
+            # slice so the [rows, items] score matrix stays ~200 MB f32
+            # regardless of catalog size (a fixed row count would scale
+            # memory with num_items)
+            num_items = model.als.item_factors.shape[0]
+            rows_per_slice = max(64, 50_000_000 // max(num_items, 1))
+            for start in range(0, len(user_rows), rows_per_slice):
+                part = user_rows[start : start + rows_per_slice]
+                idxs = np.fromiter((u for _, _, u in part), dtype=np.int64)
+                scores = model.als.user_factors[idxs] @ model.als.item_factors.T
+                for row, (qid, q, user_idx) in enumerate(part):
+                    out.append(
+                        (
+                            qid,
+                            self._topk_response(
+                                model, scores[row], q, int(q.get("num", 10)), user_idx
+                            ),
+                        )
+                    )
+        out.extend((qid, self.predict(model, q)) for qid, q in fallback)
+        return out
+
+    @staticmethod
+    def _topk_response(
+        model: RecommendationModel, scores: np.ndarray, query, num: int, user_idx: int
+    ) -> dict:
+        """Shared filter + top-k over one user's item scores (predict and
+        the vectorized batch path must rank identically)."""
         # blackList always applies; the seen-items filter is opt-out
         exclude = {
             model.item_index[b]
@@ -297,6 +339,13 @@ class ALSAlgorithm(TPUAlgorithm):
                 if np.isfinite(scores[i])
             ]
         }
+
+    def _recommend_for_user(self, model: RecommendationModel, query, num: int) -> dict:
+        user_idx = model.user_index.get(str(query["user"]))
+        if user_idx is None:
+            return {"itemScores": []}  # cold user: reference returns empty
+        scores = model.als.score_items_for_user(user_idx)
+        return self._topk_response(model, scores, query, num, user_idx)
 
     def _similar_items(self, model: RecommendationModel, query, num: int) -> dict:
         sims = None

@@ -18,6 +18,10 @@ from predictionio_tpu.workflow.core_workflow import (
 )
 from predictionio_tpu.workflow.json_extractor import EngineVariant, build_engine
 
+#: queries scored per batch_predict call (bounds the [chunk, items] score
+#: matrix a vectorized algorithm materializes)
+_CHUNK = 4096
+
 
 def run_batch_predict(
     variant: EngineVariant,
@@ -39,17 +43,40 @@ def run_batch_predict(
 
     count = 0
     with open(input_path) as fin, open(output_path, "w") as fout:
+
+        def flush(chunk_objs: list) -> None:
+            nonlocal count
+            if not chunk_objs:
+                return
+            # route through the batch_predict hook (reference
+            # batchPredictBase): algorithms with a vectorized override (ALS
+            # scores a chunk as ONE matmul) get their batch shape; the
+            # default falls back to looped predict
+            per_algo = []
+            for a, m in zip(algorithms, models):
+                queries = [
+                    (i, a.query_from_json(obj)) for i, obj in enumerate(chunk_objs)
+                ]
+                per_algo.append(dict(a.batch_predict(m, queries)))
+            for i, obj in enumerate(chunk_objs):
+                predictions = [results[i] for results in per_algo]
+                result = serving.serve(
+                    algorithms[0].query_from_json(obj), predictions
+                )
+                result_json = algorithms[0].result_to_json(result)
+                fout.write(
+                    json.dumps({"query": obj, "prediction": result_json}) + "\n"
+                )
+                count += 1
+            chunk_objs.clear()
+
+        chunk: list = []
         for line in fin:
             line = line.strip()
             if not line:
                 continue
-            query_obj = json.loads(line)
-            predictions = [
-                a.predict(m, a.query_from_json(query_obj))
-                for a, m in zip(algorithms, models)
-            ]
-            result = serving.serve(algorithms[0].query_from_json(query_obj), predictions)
-            result_json = algorithms[0].result_to_json(result)
-            fout.write(json.dumps({"query": query_obj, "prediction": result_json}) + "\n")
-            count += 1
+            chunk.append(json.loads(line))
+            if len(chunk) >= _CHUNK:
+                flush(chunk)
+        flush(chunk)
     return count

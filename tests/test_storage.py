@@ -258,3 +258,26 @@ class TestStoreFacades:
         # unsupported filters (entity_id) transparently use the row path
         filtered = PEventStore.dataset("FastScan", entity_id="u3")
         assert len(filtered) == 2 and len(filtered.events) == 2
+
+    def test_dataset_survives_sql_rejected_json(self, storage_env):
+        """python's json accepts NaN but SQL JSON functions reject it: one
+        such stored row must degrade dataset() to the row path (which
+        parses it fine), not abort training for the whole app."""
+        from predictionio_tpu.data.store import PEventStore
+
+        apps = storage_env.get_meta_data_apps()
+        apps.insert(App(name="NaNApp"))
+        app_id = apps.get_by_name("NaNApp").id
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        le.batch_insert(
+            [
+                mk_event(0, name="rate", eid="u1", tid="i1", props={"rating": 4.0}),
+                mk_event(1, name="rate", eid="u2", tid="i1",
+                         props={"rating": float("nan")}),
+            ],
+            app_id=app_id,
+        )
+        ds = PEventStore.dataset("NaNApp")
+        assert len(ds) == 2
+        assert ds.ratings[0] == 4.0

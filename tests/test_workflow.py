@@ -269,3 +269,60 @@ class TestBatchPredict:
         lines = [json.loads(l) for l in out.read_text().splitlines() if l]
         assert lines[0]["prediction"]["rating"] == pytest.approx(3.0)
         assert lines[1]["query"] == {"user": "u2"}
+
+    def test_als_vectorized_batch_matches_looped_predict(self, storage_env):
+        """ALSAlgorithm.batch_predict scores a chunk as one matmul; ranking
+        (including blackList/unseenOnly filters, cold users, and item
+        queries routed to the fallback) must match per-query predict()."""
+        import numpy as np
+
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.models.recommendation.engine import engine_factory
+        from predictionio_tpu.workflow.context import RuntimeContext
+
+        app_id = storage_env.get_meta_data_apps().insert(App(name="BatchApp"))
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        rng = np.random.default_rng(4)
+        le.batch_insert(
+            [
+                Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{int(i)}",
+                      properties=DataMap({"rating": float(rng.integers(1, 6))}))
+                for u in range(15) for i in rng.choice(10, 4, replace=False)
+            ],
+            app_id,
+        )
+        ep = EngineParams.from_json_obj(
+            {"datasource": {"params": {"appName": "BatchApp"}},
+             "algorithms": [{"name": "als", "params":
+                             {"rank": 4, "numIterations": 3, "lambda": 0.05}}]}
+        )
+        engine = engine_factory()
+        models = engine.train(RuntimeContext(), ep)
+        algo = engine._algorithms(ep)[0]
+        queries = [
+            {"user": "u1", "num": 3},
+            {"user": "u2", "num": 5, "unseenOnly": False},
+            {"user": "u3", "num": 3, "blackList": ["i0", "i1"]},
+            {"user": "nobody", "num": 3},          # cold -> fallback
+            {"items": ["i2"], "num": 4},            # similarity -> fallback
+        ]
+        batched = dict(algo.batch_predict(models[0], list(enumerate(queries))))
+        for qid, q in enumerate(queries):
+            single = algo.predict(models[0], q)
+            got, want = batched[qid]["itemScores"], single["itemScores"]
+            # gemm vs gemv round differently in the last ulps, and argsort
+            # order on near-ties follows those bits: require the same item
+            # SET with matching per-item scores, and identical order
+            # wherever adjacent score gaps exceed the float tolerance
+            got_map = {s["item"]: s["score"] for s in got}
+            want_map = {s["item"]: s["score"] for s in want}
+            assert got_map.keys() == want_map.keys(), q
+            for item, score in got_map.items():
+                assert score == pytest.approx(want_map[item], rel=1e-5), (q, item)
+            for i in range(len(want) - 1):
+                if want[i]["score"] - want[i + 1]["score"] > 1e-4:
+                    assert got[i]["item"] == want[i]["item"], (q, i)
