@@ -278,8 +278,10 @@ class ALSAlgorithm(TPUAlgorithm):
         """Vectorized bulk scoring: all known-user recommendation queries in
         one chunk score as a SINGLE [B, K] @ [K, items] matmul instead of B
         gemvs + python per query (the reference's P2LAlgorithm.batchPredict
-        parallelism, as one MXU-shaped product). Cold users, item-similarity
-        queries, and malformed queries fall back to predict()."""
+        parallelism, as one MXU-shaped product). Cold users and
+        item-similarity queries fall back to predict(); malformed queries
+        raise predict()'s normal error (the batch-predict workflow converts
+        those to per-row error records)."""
         user_rows = []  # (qid, query, user_idx)
         fallback = []
         for qid, q in queries:

@@ -272,7 +272,33 @@ def als_fit(
         )
 
     row = NamedSharding(mesh, PartitionSpec("data"))
-    put_row = lambda a: jax.device_put(a, row)
+    n_proc, pid = jax.process_count(), jax.process_index()
+
+    def put_row(a):
+        """Global row-sharded array. Multi-host: every process loads the
+        same event store, so each contributes only ITS row slice (row
+        counts are padded to 8*num_shards multiples, hence divisible by
+        the process count for any mesh built from jax.devices() order)."""
+        if n_proc > 1:
+            if a.shape[0] % n_proc:
+                raise ValueError(
+                    f"{a.shape[0]} rows do not divide across {n_proc}"
+                    " processes -- build_als_data with num_shards = the"
+                    " mesh's data-axis size"
+                )
+            per = a.shape[0] // n_proc
+            local = a[pid * per : (pid + 1) * per]
+            return jax.make_array_from_process_local_data(row, local)
+        return jax.device_put(a, row)
+
+    def fetch(arr) -> np.ndarray:
+        """Host copy of a (possibly multi-host) row-sharded array."""
+        if n_proc > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+        return np.asarray(arr)
+
     u_idx = put_row(data.by_row.indices)
     u_val = put_row(data.by_row.values)
     u_msk = put_row(data.by_row.mask)
@@ -280,8 +306,8 @@ def als_fit(
     i_val = put_row(data.by_col.values)
     i_msk = put_row(data.by_col.mask)
 
-    user_factors = jax.device_put(users0.astype(dtype), row)
-    item_factors = jax.device_put(items0.astype(dtype), row)
+    user_factors = put_row(users0.astype(dtype))
+    item_factors = put_row(items0.astype(dtype))
 
     iteration = make_iteration(mesh, config)
 
@@ -301,16 +327,12 @@ def als_fit(
             # and serving stay dtype-stable across bf16 runs
             callback(
                 it,
-                np.asarray(user_factors)[: data.by_row.num_rows].astype(
-                    np.float32
-                ),
-                np.asarray(item_factors)[: data.by_col.num_rows].astype(
-                    np.float32
-                ),
+                fetch(user_factors)[: data.by_row.num_rows].astype(np.float32),
+                fetch(item_factors)[: data.by_col.num_rows].astype(np.float32),
             )
 
     # serving model is always f32 host-side (numpy top-k math on bf16 via
     # ml_dtypes is slow and lossy; the dtype knob is a TRAINING layout)
-    user_np = np.asarray(user_factors)[: data.by_row.num_rows].astype(np.float32)
-    item_np = np.asarray(item_factors)[: data.by_col.num_rows].astype(np.float32)
+    user_np = fetch(user_factors)[: data.by_row.num_rows].astype(np.float32)
+    item_np = fetch(item_factors)[: data.by_col.num_rows].astype(np.float32)
     return ALSModel(user_factors=user_np, item_factors=item_np)

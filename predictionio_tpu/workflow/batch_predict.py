@@ -44,6 +44,14 @@ def run_batch_predict(
     count = 0
     with open(input_path) as fin, open(output_path, "w") as fout:
 
+        def score_one(obj) -> dict:
+            predictions = [
+                a.predict(m, a.query_from_json(obj))
+                for a, m in zip(algorithms, models)
+            ]
+            result = serving.serve(algorithms[0].query_from_json(obj), predictions)
+            return {"query": obj, "prediction": algorithms[0].result_to_json(result)}
+
         def flush(chunk_objs: list) -> None:
             nonlocal count
             if not chunk_objs:
@@ -52,21 +60,35 @@ def run_batch_predict(
             # batchPredictBase): algorithms with a vectorized override (ALS
             # scores a chunk as ONE matmul) get their batch shape; the
             # default falls back to looped predict
-            per_algo = []
-            for a, m in zip(algorithms, models):
-                queries = [
-                    (i, a.query_from_json(obj)) for i, obj in enumerate(chunk_objs)
-                ]
-                per_algo.append(dict(a.batch_predict(m, queries)))
-            for i, obj in enumerate(chunk_objs):
-                predictions = [results[i] for results in per_algo]
-                result = serving.serve(
-                    algorithms[0].query_from_json(obj), predictions
-                )
-                result_json = algorithms[0].result_to_json(result)
-                fout.write(
-                    json.dumps({"query": obj, "prediction": result_json}) + "\n"
-                )
+            try:
+                per_algo = []
+                for a, m in zip(algorithms, models):
+                    queries = [
+                        (i, a.query_from_json(obj))
+                        for i, obj in enumerate(chunk_objs)
+                    ]
+                    per_algo.append(dict(a.batch_predict(m, queries)))
+                rows = []
+                for i, obj in enumerate(chunk_objs):
+                    predictions = [results[i] for results in per_algo]
+                    result = serving.serve(
+                        algorithms[0].query_from_json(obj), predictions
+                    )
+                    rows.append(
+                        {"query": obj, "prediction": algorithms[0].result_to_json(result)}
+                    )
+            except Exception:
+                # one malformed query must not discard the chunk's other
+                # results: degrade to per-query scoring, recording an error
+                # row for each query that fails
+                rows = []
+                for obj in chunk_objs:
+                    try:
+                        rows.append(score_one(obj))
+                    except Exception as exc:
+                        rows.append({"query": obj, "error": str(exc)})
+            for row in rows:
+                fout.write(json.dumps(row) + "\n")
                 count += 1
             chunk_objs.clear()
 

@@ -270,6 +270,31 @@ class TestBatchPredict:
         assert lines[0]["prediction"]["rating"] == pytest.approx(3.0)
         assert lines[1]["query"] == {"user": "u2"}
 
+    def test_malformed_query_yields_error_row_not_lost_chunk(
+        self, rated_app, tmp_path
+    ):
+        """One bad query among good ones: the good ones keep their
+        predictions and the bad one gets an error record -- a chunked
+        runner must not discard the chunk."""
+        from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+        # the ALS template raises on a query with neither user nor items
+        variant = write_variant(
+            tmp_path,
+            [{"name": "als", "params": {"rank": 4, "numIterations": 2,
+                                        "lambda": 0.05}}],
+            factory="predictionio_tpu.models.recommendation.engine.engine_factory",
+        )
+        run_train(variant)
+        qfile = tmp_path / "queries.jsonl"
+        qfile.write_text('{"user": "u1"}\n{"bogus": true}\n{"user": "u2"}\n')
+        out = tmp_path / "out.jsonl"
+        count = run_batch_predict(variant, str(qfile), str(out))
+        assert count == 3
+        lines = [json.loads(l) for l in out.read_text().splitlines() if l]
+        assert "prediction" in lines[0] and "prediction" in lines[2]
+        assert "error" in lines[1] and lines[1]["query"] == {"bogus": True}
+
     def test_als_vectorized_batch_matches_looped_predict(self, storage_env):
         """ALSAlgorithm.batch_predict scores a chunk as one matmul; ranking
         (including blackList/unseenOnly filters, cold users, and item
