@@ -158,15 +158,11 @@ def _cooccurrence_mesh(
             out_specs=rep,
         )
     )
+    from predictionio_tpu.parallel.mesh import fetch_global, put_row_global
+
     sharding = NamedSharding(mesh, row)
-    return np.asarray(
-        fn(
-            jax.device_put(idx_p, sharding),
-            jax.device_put(msk_p, sharding),
-            jax.device_put(idx_o, sharding),
-            jax.device_put(msk_o, sharding),
-        )
-    )
+    put = lambda a: put_row_global(sharding, a)
+    return fetch_global(fn(put(idx_p), put(msk_p), put(idx_o), put(msk_o)))
 
 
 def distinct_user_counts(csr: PaddedCSR) -> np.ndarray:

@@ -271,33 +271,17 @@ def als_fit(
             data.by_col.num_rows, data.by_col.indices.shape[0], config.seed + 1
         )
 
+    from predictionio_tpu.parallel.mesh import fetch_global as fetch
+    from predictionio_tpu.parallel.mesh import put_row_global
+
     row = NamedSharding(mesh, PartitionSpec("data"))
-    n_proc, pid = jax.process_count(), jax.process_index()
-
-    def put_row(a):
-        """Global row-sharded array. Multi-host: every process loads the
-        same event store, so each contributes only ITS row slice (row
-        counts are padded to 8*num_shards multiples, hence divisible by
-        the process count for any mesh built from jax.devices() order)."""
-        if n_proc > 1:
-            if a.shape[0] % n_proc:
-                raise ValueError(
-                    f"{a.shape[0]} rows do not divide across {n_proc}"
-                    " processes -- build_als_data with num_shards = the"
-                    " mesh's data-axis size"
-                )
-            per = a.shape[0] // n_proc
-            local = a[pid * per : (pid + 1) * per]
-            return jax.make_array_from_process_local_data(row, local)
-        return jax.device_put(a, row)
-
-    def fetch(arr) -> np.ndarray:
-        """Host copy of a (possibly multi-host) row-sharded array."""
-        if n_proc > 1:
-            from jax.experimental import multihost_utils
-
-            return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
-        return np.asarray(arr)
+    # multi-host: every process loads the same event store; put_row feeds
+    # each process's row slice (row counts are padded to 8*num_shards
+    # multiples, hence divisible by the process count for any mesh built
+    # from jax.devices() order)
+    put_row = lambda a: put_row_global(
+        row, a, advice="build_als_data with num_shards = the mesh's data-axis size"
+    )
 
     u_idx = put_row(data.by_row.indices)
     u_val = put_row(data.by_row.values)

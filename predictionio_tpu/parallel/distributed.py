@@ -42,6 +42,19 @@ LAUNCH_SCOPED_KEYS = ("pio.coordinator", "pio.num_processes", "pio.process_id")
 LAUNCH_SCOPED_ENV = ("PIO_COORDINATOR", "PIO_NUM_PROCESSES", "PIO_PROCESS_ID")
 
 
+def launch_process_id(runtime_conf=None) -> int:
+    """This process's rank under the launcher contract, 0 when standalone.
+
+    Usable BEFORE jax.distributed initializes (which happens lazily inside
+    mesh construction): run_train needs the rank up front to decide which
+    process owns the persistence side effects (lock, instance row, model
+    blob, step checkpoints).
+    """
+    if runtime_conf and runtime_conf.get("pio.process_id") is not None:
+        return int(runtime_conf["pio.process_id"])
+    return int(os.environ.get("PIO_PROCESS_ID", "0") or 0)
+
+
 def strip_launch_conf(runtime_conf: dict | None) -> dict:
     """Drop launch-scoped keys before persisting runtime conf."""
     return {

@@ -29,6 +29,39 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def put_row_global(sharding: NamedSharding, a, advice: str = ""):
+    """Row-sharded global array that also works on MULTI-PROCESS meshes.
+
+    Single process: a plain sharded device_put. Multi process: every
+    process is assumed to hold the SAME full array (each read the same
+    event store), so each contributes only its row slice via
+    ``make_array_from_process_local_data``.
+    """
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return jax.device_put(a, sharding)
+    if a.shape[0] % n_proc:
+        raise ValueError(
+            f"{a.shape[0]} rows do not divide across {n_proc} processes"
+            + (f" -- {advice}" if advice else "")
+        )
+    per = a.shape[0] // n_proc
+    pid = jax.process_index()
+    return jax.make_array_from_process_local_data(
+        sharding, a[pid * per : (pid + 1) * per]
+    )
+
+
+def fetch_global(arr) -> np.ndarray:
+    """Host copy of a (possibly multi-process) sharded array: allgathers
+    across processes when local devices cannot address every shard."""
+    if jax.process_count() > 1 and not arr.is_fully_replicated:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return np.asarray(arr)
+
+
 def shard_examples(mesh: Mesh | None, x, y):
     """Shared dp entry for the full-batch trainers (NB, LogReg).
 

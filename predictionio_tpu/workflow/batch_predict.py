@@ -9,6 +9,7 @@ deployed-equivalent model chain.
 from __future__ import annotations
 
 import json
+import logging
 
 from predictionio_tpu.data import storage
 from predictionio_tpu.workflow.context import RuntimeContext
@@ -17,6 +18,8 @@ from predictionio_tpu.workflow.core_workflow import (
     resolve_engine_instance,
 )
 from predictionio_tpu.workflow.json_extractor import EngineVariant, build_engine
+
+logger = logging.getLogger("pio.batchpredict")
 
 #: queries scored per batch_predict call (bounds the [chunk, items] score
 #: matrix a vectorized algorithm materializes)
@@ -79,8 +82,17 @@ def run_batch_predict(
                     )
             except Exception:
                 # one malformed query must not discard the chunk's other
-                # results: degrade to per-query scoring, recording an error
-                # row for each query that fails
+                # results: degrade to per-query scoring (slow, but only
+                # chunks containing a failing query pay), recording an
+                # error row for each query that fails. Log the trigger --
+                # a SYSTEMIC failure (model regression, corrupt blob) would
+                # otherwise masquerade as per-row input errors
+                logger.warning(
+                    "batch scoring failed for a %d-query chunk; rescoring"
+                    " per query",
+                    len(chunk_objs),
+                    exc_info=True,
+                )
                 rows = []
                 for obj in chunk_objs:
                     try:

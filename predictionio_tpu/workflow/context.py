@@ -86,6 +86,12 @@ class RuntimeContext:
         key = self.run_key or self.instance_id
         if key is None:
             return None
+        from predictionio_tpu.parallel.distributed import launch_process_id
+
+        if launch_process_id(self.runtime_conf) != 0:
+            # multi-process launch: rank 0 owns the checkpoint dir; a
+            # second writer on the same key would corrupt its steps
+            return None
         from predictionio_tpu.workflow.checkpoint import CheckpointManager
 
         return CheckpointManager(f"{name}-{key}", fresh=not self.resume)

@@ -101,6 +101,28 @@ def run_train(
     )
     run_key = _run_key(variant, params_jsons)
 
+    from predictionio_tpu.parallel.distributed import launch_process_id
+
+    if launch_process_id(variant.runtime_conf) != 0:
+        # multi-process launch, non-primary rank: run the training compute
+        # (every rank must participate in the collectives) but own NO
+        # persistence side effects -- no run lock (ranks on one host share
+        # PIO_FS_BASEDIR), no instance row, no step checkpoints, no model
+        # blob. Rank 0 is the system of record.
+        ctx = RuntimeContext(variant.runtime_conf, resume=workflow_params.resume)
+        engine.train(
+            ctx, engine_params, skip_sanity_check=workflow_params.skip_sanity_check
+        )
+        return EngineInstance(
+            status=STATUS_COMPLETED,
+            start_time=_utcnow(),
+            end_time=_utcnow(),
+            engine_id=variant.variant_id,
+            engine_version=variant.engine_version,
+            engine_variant=variant.path,
+            engine_factory=variant.engine_factory,
+        )
+
     # serialize trains sharing this run_key: a second identical train would
     # wipe the first's live step checkpoints (fresh=True) and --resume would
     # adopt its still-RUNNING instance. Raises RunLockHeld when the holder

@@ -141,3 +141,56 @@ def test_two_process_als_matches_single_process(tmp_path):
     got = np.load(out)
     np.testing.assert_allclose(got["users"], ref.user_factors, atol=2e-2)
     np.testing.assert_allclose(got["items"], ref.item_factors, atol=2e-2)
+
+
+_COOC_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from predictionio_tpu.parallel.distributed import init_distributed, build_mesh
+    from predictionio_tpu.ops.cooccurrence import cooccurrence
+    from predictionio_tpu.ops.ragged import pack_padded_csr
+    import numpy as np
+
+    pid = int(sys.argv[1])
+    assert init_distributed({coord!r}, 2, pid)
+    mesh = build_mesh([8, 1], ("data", "model"))
+    rng = np.random.default_rng(21)
+    dense = (rng.random((70, 11)) < 0.3).astype(np.float32)
+    uu, ii = np.nonzero(dense)
+    csr = pack_padded_csr(uu, ii, np.ones(len(uu), np.float32), 70, 11)
+    cooc = cooccurrence(csr, mesh=mesh, chunk=8)
+    expected = np.minimum(dense, 1.0).T @ np.minimum(dense, 1.0)
+    np.testing.assert_allclose(cooc, expected, atol=1e-4)
+    print("OK", flush=True)
+    """
+)
+
+
+def test_two_process_cooccurrence(tmp_path):
+    """Sharded cooccurrence across two OS processes: each feeds its user
+    rows, the psum crosses the process boundary, and every process gets
+    the full (replicated) [items, items] result."""
+    import predictionio_tpu
+
+    repo = str(next(iter(predictionio_tpu.__path__)) + "/..")
+    script = tmp_path / "cooc_worker.py"
+    script.write_text(
+        _COOC_WORKER.format(repo=repo, coord=f"127.0.0.1:{_free_port()}")
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, text in zip(procs, outs):
+        assert p.returncode == 0, text
+        assert "OK" in text

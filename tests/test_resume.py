@@ -307,6 +307,28 @@ class TestResumeWorkflow:
         # holder gone -> train proceeds normally
         assert run_train(variant).status == STATUS_COMPLETED
 
+    def test_non_primary_rank_owns_no_persistence(
+        self, storage_env, tmp_path, monkeypatch
+    ):
+        """Under a multi-process launch, rank != 0 must train (it has to
+        join the collectives) but write NOTHING: no instance row, no model
+        blob, no step checkpoints, no run lock (ranks on one host share
+        PIO_FS_BASEDIR -- a second lock holder would refuse rank 1)."""
+        seed_ratings(storage_env)
+        variant = als_variant(tmp_path)
+        monkeypatch.setenv("PIO_PROCESS_ID", "1")
+        result = run_train(variant)
+        assert result.status == STATUS_COMPLETED
+        assert storage_env.get_meta_data_engine_instances().get_all() == []
+        ckpt_root = os.path.join(os.environ["PIO_FS_BASEDIR"], "checkpoints")
+        leftovers = os.listdir(ckpt_root) if os.path.isdir(ckpt_root) else []
+        assert leftovers == []  # no checkpoints AND no lockfile
+        # rank 0 behaves normally
+        monkeypatch.setenv("PIO_PROCESS_ID", "0")
+        primary = run_train(variant)
+        assert primary.status == STATUS_COMPLETED
+        assert len(storage_env.get_meta_data_engine_instances().get_all()) == 1
+
     def test_stale_lock_from_dead_process_is_taken_over(
         self, storage_env, tmp_path
     ):
