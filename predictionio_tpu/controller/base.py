@@ -157,11 +157,11 @@ class TPUAlgorithm(Algorithm[PD, M, Q, P]):
         misconfigured pod coordinator should not silently train on one
         host. The common benign case is a context with no devices at all
         (pure-host tests)."""
-        import logging
-
         try:
             return ctx.mesh
         except Exception:
+            import logging
+
             logging.getLogger("pio.controller").warning(
                 "mesh unavailable; training unsharded", exc_info=True
             )

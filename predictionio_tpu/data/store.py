@@ -14,10 +14,13 @@ Behavioral model: reference ``data/.../store/{LEventStore,PEventStore}.scala``
 from __future__ import annotations
 
 import datetime as _dt
+import logging
 from dataclasses import dataclass
 from typing import Iterator
 
 import numpy as np
+
+logger = logging.getLogger("pio.store")
 
 from predictionio_tpu.data import storage as storage_registry
 from predictionio_tpu.data.datamap import PropertyMap
@@ -297,9 +300,7 @@ class PEventStore:
                 # reject (python's json accepts NaN, SQL JSON does not):
                 # the row path parses it fine, so degrade instead of
                 # failing training for the whole app
-                import logging
-
-                logging.getLogger("pio.store").warning(
+                logger.warning(
                     "columnar fast scan failed for app %r; falling back to"
                     " the row path",
                     app_name,

@@ -202,9 +202,6 @@ def clear_run_checkpoints(run_key: str, base_dir: str | None = None) -> None:
     import glob
     import shutil
 
-    base = base_dir or os.path.join(
-        os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store")),
-        "checkpoints",
-    )
+    base = _checkpoint_base(base_dir)
     for path in glob.glob(os.path.join(base, f"*-{run_key}")):
         shutil.rmtree(path, ignore_errors=True)
