@@ -24,7 +24,17 @@ DEFAULT_PORT = 7071
 
 class AdminService:
     def __init__(self):
-        self.router = Router()
+        from predictionio_tpu.utils import metrics as metrics_mod
+
+        self.metrics = metrics_mod.MetricsRegistry()
+        self.router = Router(metrics=self.metrics)
+        self.router.add(
+            "GET",
+            "/metrics",
+            lambda req: Response(
+                200, self.metrics.exposition(), content_type=metrics_mod.CONTENT_TYPE
+            ),
+        )
         self.router.add("GET", "/", self.handle_info)
         self.router.add("GET", "/cmd/app", self.handle_list)
         self.router.add("POST", "/cmd/app", self.handle_create)

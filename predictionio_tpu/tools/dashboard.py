@@ -26,7 +26,17 @@ _PAGE = """<!DOCTYPE html>
 
 class DashboardService:
     def __init__(self):
-        self.router = Router()
+        from predictionio_tpu.utils import metrics as metrics_mod
+
+        self.metrics = metrics_mod.MetricsRegistry()
+        self.router = Router(metrics=self.metrics)
+        self.router.add(
+            "GET",
+            "/metrics",
+            lambda req: Response(
+                200, self.metrics.exposition(), content_type=metrics_mod.CONTENT_TYPE
+            ),
+        )
         self.router.add("GET", "/", self.handle_index)
         self.router.add("GET", "/engine_instances", self.handle_engine_instances)
         self.router.add("GET", "/evaluation_instances.json", self.handle_list_json)

@@ -98,6 +98,22 @@ class TestEventServerMetrics:
         assert 'pio_http_request_duration_seconds_bucket{le="+Inf",route="/events.json"}' in text
 
 
+class TestDashboardAdminMetrics:
+    def test_dashboard_and_admin_expose_metrics(self, storage_env):
+        from predictionio_tpu.tools.adminserver import AdminService
+        from predictionio_tpu.tools.dashboard import DashboardService
+        from predictionio_tpu.utils.http import Request
+
+        for service in (DashboardService(), AdminService()):
+            req = Request("GET", "/", {}, {}, b"", {})
+            assert service.router.dispatch(req).status == 200
+            resp = service.router.dispatch(
+                Request("GET", "/metrics", {}, {}, b"", {})
+            )
+            assert resp.status == 200
+            assert 'pio_http_requests_total{method="GET",route="/",status="200"} 1' in resp.body
+
+
 class TestQueryServerMetrics:
     def test_queries_served_counter(self, storage_env, tmp_path):
         import numpy as np
