@@ -35,12 +35,11 @@ from predictionio_tpu.data.event import (
 )
 from predictionio_tpu.data.storage.base import AccessKey
 from predictionio_tpu.data import webhooks as webhook_registry
-from predictionio_tpu.utils import metrics as metrics_mod
 from predictionio_tpu.utils.http import (
     Request,
     Response,
-    Router,
     ServiceThread,
+    instrumented_router,
     make_server,
 )
 
@@ -104,11 +103,9 @@ class EventService:
         self.stats_enabled = stats
         self.stats = _Stats()
         self.plugins = list(plugins or [])
-        self.metrics = metrics_mod.MetricsRegistry()
-        self.router = Router(metrics=self.metrics)
+        self.router, self.metrics = instrumented_router()
         r = self.router
         r.add("GET", "/", self.handle_root)
-        r.add("GET", "/metrics", self.handle_metrics)
         r.add("POST", "/events.json", self.handle_create_event)
         r.add("GET", "/events.json", self.handle_find_events)
         r.add("GET", "/events/<event_id>.json", self.handle_get_event)
@@ -165,11 +162,6 @@ class EventService:
     # -- handlers -----------------------------------------------------------
     def handle_root(self, request: Request) -> Response:
         return Response(200, {"status": "alive"})
-
-    def handle_metrics(self, request: Request) -> Response:
-        return Response(
-            200, self.metrics.exposition(), content_type=metrics_mod.CONTENT_TYPE
-        )
 
     def _insert_one(
         self, obj: Any, record: AccessKey, channel_id: int | None

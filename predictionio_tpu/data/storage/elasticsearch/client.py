@@ -657,20 +657,18 @@ class ESLEvents(base.LEvents):
     ) -> bool:
         return self.c.delete_doc(self._kind(app_id, channel_id), event_id)
 
-    def find(
-        self,
-        app_id: int,
-        channel_id: int | None = None,
-        start_time: _dt.datetime | None = None,
-        until_time: _dt.datetime | None = None,
-        entity_type: str | None = None,
-        entity_id: str | None = None,
-        event_names: list[str] | None = None,
+    @staticmethod
+    def _build_query(
+        start_time=None,
+        until_time=None,
+        entity_type=None,
+        entity_id=None,
+        event_names=None,
         target_entity_type=...,
         target_entity_id=...,
-        limit: int | None = None,
-        reversed: bool = False,
-    ) -> Iterator[Event]:
+    ) -> dict:
+        """Filter DSL shared by find() and scan_interactions(): one
+        definition so the row and columnar paths cannot desynchronize."""
         filters: list[dict] = []
         must_not: list[dict] = []
         time_range: dict = {}
@@ -696,11 +694,21 @@ class ESLEvents(base.LEvents):
                 must_not.append({"exists": {"field": "target_entity_id"}})
             else:
                 filters.append({"term": {"target_entity_id": target_entity_id}})
-        query = {"bool": {"filter": filters, "must_not": must_not}}
+        return {"bool": {"filter": filters, "must_not": must_not}}
+
+    def _scan(
+        self,
+        app_id: int,
+        channel_id: int | None,
+        query: dict,
+        reversed: bool = False,
+        limit: int | None = None,
+        source_fields: list[str] | None = None,
+    ) -> Iterator[dict]:
+        """search_after-paginated hit stream (sources only)."""
         order = "desc" if reversed else "asc"
         sort = [{"event_time_ms": order}, {"event_id": order}]
         index = self.c.index_name(self._kind(app_id, channel_id))
-
         remaining = limit if (limit is not None and limit >= 0) else None
         search_after = None
         while True:
@@ -708,6 +716,8 @@ class ESLEvents(base.LEvents):
             if page == 0:
                 return
             body = {"query": query, "size": page, "sort": sort}
+            if source_fields is not None:
+                body["_source"] = source_fields
             if search_after is not None:
                 body["search_after"] = search_after
             status, result = self.c.transport.request(
@@ -717,7 +727,7 @@ class ESLEvents(base.LEvents):
                 return
             hits = result["hits"]["hits"]
             for h in hits:
-                yield self._to_event(h["_source"])
+                yield h["_source"]
             if remaining is not None:
                 remaining -= len(hits)
                 if remaining <= 0:
@@ -725,3 +735,85 @@ class ESLEvents(base.LEvents):
             if len(hits) < page:
                 return
             search_after = hits[-1]["sort"]
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: list[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        query = self._build_query(
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
+        for source in self._scan(
+            app_id, channel_id, query, reversed=reversed, limit=limit
+        ):
+            yield self._to_event(source)
+
+    def scan_interactions(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        event_names: list[str] | None = None,
+        target_entity_type=...,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        rating_key: str = "rating",
+    ):
+        """Columnar training scan (same contract as the SQL backends'
+        ``scan_interactions``): five parallel lists, no Event/DataMap
+        construction per hit, ``_source`` filtered to the training columns.
+        The rating still needs a host-side parse of the properties JSON
+        string, gated on a cheap substring test so unrated events skip it;
+        the number-only rule matches ``EventDataset.from_events``.
+        """
+        query = self._build_query(
+            start_time=start_time,
+            until_time=until_time,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+        )
+        # the stored properties string came from json.dumps, so build the
+        # needle the same way: a non-ASCII key is stored \u-escaped and a
+        # raw f'"{key}"' would never match it
+        needle = json.dumps(rating_key)
+        ents: list = []
+        tgts: list = []
+        names: list = []
+        times: list = []
+        ratings: list = []
+        for s in self._scan(
+            app_id,
+            channel_id,
+            query,
+            source_fields=[
+                "entity_id", "target_entity_id", "event", "event_time",
+                "properties",
+            ],
+        ):
+            ents.append(s["entity_id"])
+            tgts.append(s.get("target_entity_id"))
+            names.append(s["event"])
+            times.append(s["event_time"])
+            rating = None
+            props = s.get("properties")
+            if props and needle in props:
+                value = json.loads(props).get(rating_key)
+                if isinstance(value, (int, float)) and not isinstance(value, bool):
+                    rating = value
+            ratings.append(rating)
+        return ents, tgts, names, times, ratings

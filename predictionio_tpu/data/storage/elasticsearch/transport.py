@@ -243,6 +243,12 @@ class FakeTransport:
                 h["_source"].get(next(iter(c))) if isinstance(c, dict) else None
                 for c in body.get("sort", [])
             ]
+        source_filter = body.get("_source")
+        if isinstance(source_filter, list):
+            for h in hits:
+                h["_source"] = {
+                    k: v for k, v in h["_source"].items() if k in source_filter
+                }
         return 200, {"hits": {"total": {"value": len(hits)}, "hits": hits}}
 
     @staticmethod

@@ -17,24 +17,20 @@ from __future__ import annotations
 import json
 
 from predictionio_tpu.data import storage
-from predictionio_tpu.utils.http import Request, Response, Router, ServiceThread, make_server
+from predictionio_tpu.utils.http import (
+    Request,
+    Response,
+    ServiceThread,
+    instrumented_router,
+    make_server,
+)
 
 DEFAULT_PORT = 7071
 
 
 class AdminService:
     def __init__(self):
-        from predictionio_tpu.utils import metrics as metrics_mod
-
-        self.metrics = metrics_mod.MetricsRegistry()
-        self.router = Router(metrics=self.metrics)
-        self.router.add(
-            "GET",
-            "/metrics",
-            lambda req: Response(
-                200, self.metrics.exposition(), content_type=metrics_mod.CONTENT_TYPE
-            ),
-        )
+        self.router, self.metrics = instrumented_router()
         self.router.add("GET", "/", self.handle_info)
         self.router.add("GET", "/cmd/app", self.handle_list)
         self.router.add("POST", "/cmd/app", self.handle_create)

@@ -10,7 +10,13 @@ from __future__ import annotations
 import html
 
 from predictionio_tpu.data import storage
-from predictionio_tpu.utils.http import Request, Response, Router, ServiceThread, make_server
+from predictionio_tpu.utils.http import (
+    Request,
+    Response,
+    ServiceThread,
+    instrumented_router,
+    make_server,
+)
 
 DEFAULT_PORT = 9000
 
@@ -26,17 +32,7 @@ _PAGE = """<!DOCTYPE html>
 
 class DashboardService:
     def __init__(self):
-        from predictionio_tpu.utils import metrics as metrics_mod
-
-        self.metrics = metrics_mod.MetricsRegistry()
-        self.router = Router(metrics=self.metrics)
-        self.router.add(
-            "GET",
-            "/metrics",
-            lambda req: Response(
-                200, self.metrics.exposition(), content_type=metrics_mod.CONTENT_TYPE
-            ),
-        )
+        self.router, self.metrics = instrumented_router()
         self.router.add("GET", "/", self.handle_index)
         self.router.add("GET", "/engine_instances", self.handle_engine_instances)
         self.router.add("GET", "/evaluation_instances.json", self.handle_list_json)

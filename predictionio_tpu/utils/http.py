@@ -134,6 +134,29 @@ class Router:
         )
 
 
+def instrumented_router(before_scrape=None) -> tuple[Router, "object"]:
+    """(router, registry): a Router wired to a fresh MetricsRegistry with
+    the ``GET /metrics`` Prometheus exposition route installed -- the one
+    definition every service (event, query, dashboard, admin) shares.
+
+    ``before_scrape(registry)`` runs on every /metrics request, letting a
+    service mirror externally-tracked state (e.g. the query server's
+    served-count) into the registry without maintaining it in two places.
+    """
+    from predictionio_tpu.utils.metrics import CONTENT_TYPE, MetricsRegistry
+
+    registry = MetricsRegistry()
+    router = Router(metrics=registry)
+
+    def handle_metrics(request: Request) -> Response:
+        if before_scrape is not None:
+            before_scrape(registry)
+        return Response(200, registry.exposition(), content_type=CONTENT_TYPE)
+
+    router.add("GET", "/metrics", handle_metrics)
+    return router, registry
+
+
 _CORS_HEADERS = {
     "Access-Control-Allow-Origin": "*",
     "Access-Control-Allow-Methods": "GET, POST, DELETE, OPTIONS",

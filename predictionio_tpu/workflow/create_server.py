@@ -32,12 +32,11 @@ from dataclasses import dataclass
 from typing import Any
 
 from predictionio_tpu.controller.engine import Engine
-from predictionio_tpu.utils import metrics as metrics_mod
 from predictionio_tpu.utils.http import (
     Request,
     Response,
-    Router,
     ServiceThread,
+    instrumented_router,
     make_server,
 )
 from predictionio_tpu.workflow.context import RuntimeContext
@@ -95,10 +94,18 @@ class QueryService:
         self._started = _dt.datetime.now(_dt.timezone.utc)
         self._load_models()
 
-        self.metrics = metrics_mod.MetricsRegistry()
-        self.router = Router(metrics=self.metrics)
+        # _served stays the single source of truth (handle_info reads it);
+        # the registry only mirrors it at scrape time
+        def mirror(registry):
+            with self._lock:
+                served = self._served
+            registry.set_counter(
+                "pio_queries_served_total", served,
+                help="Queries answered successfully",
+            )
+
+        self.router, self.metrics = instrumented_router(before_scrape=mirror)
         self.router.add("GET", "/", self.handle_info)
-        self.router.add("GET", "/metrics", self.handle_metrics)
         self.router.add("POST", "/queries.json", self.handle_query)
         self.router.add("GET", "/reload", self.handle_reload)
         self.router.add("POST", "/stop", self.handle_stop)
@@ -150,19 +157,6 @@ class QueryService:
                     "serverStats": {"queryCount": self._served},
                 },
             )
-
-    def handle_metrics(self, request: Request) -> Response:
-        # _served is the single source of truth (handle_info reads it too);
-        # the registry only mirrors it at scrape time
-        with self._lock:
-            served = self._served
-        self.metrics.set_counter(
-            "pio_queries_served_total", served,
-            help="Queries answered successfully",
-        )
-        return Response(
-            200, self.metrics.exposition(), content_type=metrics_mod.CONTENT_TYPE
-        )
 
     def handle_query(self, request: Request) -> Response:
         try:
