@@ -78,6 +78,16 @@ def build_als_data(
     return ALSData(by_row=by_row, by_col=by_col)
 
 
+def _factor_precision(dtype):
+    """Matmul precision for einsums whose operands are both factor-typed.
+
+    f32 operands need "highest" (stops XLA lowering them to bf16 passes on
+    TPU); bf16 operands are already exact in a single MXU pass with f32
+    accumulation, and "highest" would force 3-pass emulation for nothing.
+    """
+    return "highest" if dtype == jnp.float32 else None
+
+
 def _half_step_explicit(indices, values, mask, factors, reg, rank, unroll):
     """Solve one side's factors given the other side's (replicated) factors.
 
@@ -92,7 +102,8 @@ def _half_step_explicit(indices, values, mask, factors, reg, rank, unroll):
     gathered = gathered * mask[..., None].astype(factors.dtype)
     gram = jnp.einsum(
         "rlk,rlj->rkj", gathered, gathered,
-        precision="highest", preferred_element_type=jnp.float32,
+        precision=_factor_precision(factors.dtype),
+        preferred_element_type=jnp.float32,
     )
     # MLlib-style weighted regularization: lambda * n_obs (ALS-WR); constant
     # lambda would also be defensible -- n_obs matches the reference template
@@ -116,7 +127,8 @@ def _half_step_implicit(indices, values, mask, factors, reg, alpha, rank, unroll
     active = factors[:-1]  # drop the padding row from the global Gram
     yty = jnp.einsum(
         "nk,nj->kj", active, active,
-        precision="highest", preferred_element_type=jnp.float32,
+        precision=_factor_precision(factors.dtype),
+        preferred_element_type=jnp.float32,
     )
     gathered = factors[indices] * mask[..., None].astype(factors.dtype)
     conf_minus_1 = alpha * values * mask
