@@ -21,7 +21,11 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import check_steps_ran
+from predictionio_tpu.parallel.mesh import (
+    check_steps_ran,
+    fetch_global,
+    put_global,
+)
 
 
 @dataclass
@@ -109,11 +113,15 @@ def train_ncf(
     )["params"]
     p_shard = param_shardings(mesh, params)
     data_shard = NamedSharding(mesh, P("data"))
-    params = jax.device_put(params, p_shard)
+    # put_global (not device_put): every process initialized identical
+    # params from the same PRNGKey; on a multi-process mesh each
+    # contributes its addressable shards of the tp layout
+    params = jax.tree_util.tree_map(put_global, params, p_shard)
     optimizer = optax.adam(config.learning_rate)
-    # init AFTER placement: adam's mu/nu zeros_like the sharded params and
-    # inherit the tp layout
-    opt_state = optimizer.init(params)
+    # init AFTER placement, jitted: adam's mu/nu zeros_like the sharded
+    # params and inherit the tp layout (eager zeros_like on non-addressable
+    # multi-process arrays would fail)
+    opt_state = jax.jit(optimizer.init)(params)
 
     step_fn = jax.jit(
         make_train_step(model, optimizer, config.implicit),
@@ -130,29 +138,44 @@ def train_ncf(
     n = users.size
     batch = config.batch_size
     n_devices = mesh.shape.get("data", 1)
+    n_proc = jax.process_count()
     step = 0
     start_epoch = 0
-    if checkpoint is not None:
-        latest = checkpoint.latest_step()
-        if latest is not None:
-            restored = checkpoint.restore(
-                {
-                    "params": jax.device_get(params),
-                    "opt_state": jax.device_get(opt_state),
-                    "epoch": 0,
-                }
-            )
-            params = jax.device_put(restored["params"], p_shard)
-            # restore Adam's moments too -- a zeroed mu/nu after resume would
-            # spike the first post-resume updates
-            opt_state = jax.tree_util.tree_map(
-                lambda a, b: jax.device_put(jnp.asarray(a), b.sharding)
-                if hasattr(b, "sharding")
-                else a,
-                restored["opt_state"],
-                opt_state,
-            )
-            start_epoch = int(restored["epoch"]) + 1
+    # resume must stay rank-SYMMETRIC on multi-process meshes: only rank 0
+    # holds a checkpoint manager, but fetch/put of sharded state are
+    # collectives every rank joins; the restored state broadcasts from
+    # rank 0 so ranks never diverge
+    latest = checkpoint.latest_step() if checkpoint is not None else None
+    any_checkpoint = checkpoint is not None
+    if n_proc > 1:
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.broadcast_one_to_all(
+            np.int64([1 if any_checkpoint else 0, -1 if latest is None else latest])
+        )
+        any_checkpoint = bool(int(flags[0]))
+        latest = None if int(flags[1]) < 0 else int(flags[1])
+    if latest is not None:
+        host_state = {
+            "params": jax.tree_util.tree_map(fetch_global, params),
+            "opt_state": jax.tree_util.tree_map(fetch_global, opt_state),
+            "epoch": 0,
+        }
+        if checkpoint is not None:
+            host_state = checkpoint.restore(host_state)
+        if n_proc > 1:
+            host_state = multihost_utils.broadcast_one_to_all(host_state)
+        params = jax.tree_util.tree_map(put_global, host_state["params"], p_shard)
+        # restore Adam's moments too -- a zeroed mu/nu after resume would
+        # spike the first post-resume updates
+        opt_state = jax.tree_util.tree_map(
+            lambda a, b: put_global(np.asarray(a), b.sharding)
+            if hasattr(b, "sharding")
+            else a,
+            host_state["opt_state"],
+            opt_state,
+        )
+        start_epoch = int(host_state["epoch"]) + 1
 
     losses = []
     for epoch in range(start_epoch, config.epochs):
@@ -163,27 +186,31 @@ def train_ncf(
                 continue
             usable = (take.size // n_devices) * n_devices
             take = take[:usable]
+            # every process computes the same permutation (same seed), so
+            # put_global can hand each exactly its addressable batch shards
             b = {
-                "user": jnp.asarray(users[take]),
-                "item": jnp.asarray(items[take]),
-                "label": jnp.asarray(labels[take], dtype=jnp.float32),
+                "user": put_global(users[take], data_shard),
+                "item": put_global(items[take], data_shard),
+                "label": put_global(labels[take].astype(np.float32), data_shard),
             }
             params, opt_state, loss = step_fn(params, opt_state, b)
             step += 1
             if log_every and step % log_every == 0:
                 losses.append(float(loss))
-        if checkpoint is not None:
-            checkpoint.save(
-                epoch,
-                {
-                    "params": jax.device_get(params),
-                    "opt_state": jax.device_get(opt_state),
-                    "epoch": epoch,
-                },
-            )
+        if any_checkpoint:
+            # the fetches are collectives: when ANY rank checkpoints, EVERY
+            # rank joins them each epoch (only rank 0 writes); with no
+            # checkpointing anywhere, nobody pays the per-epoch allgather
+            epoch_state = {
+                "params": jax.tree_util.tree_map(fetch_global, params),
+                "opt_state": jax.tree_util.tree_map(fetch_global, opt_state),
+                "epoch": epoch,
+            }
+            if checkpoint is not None:
+                checkpoint.save(epoch, epoch_state)
     if start_epoch < config.epochs:
         check_steps_ran(step, n, n_devices, "example")
-    return jax.device_get(params), losses
+    return jax.tree_util.tree_map(fetch_global, params), losses
 
 
 def make_implicit_batches(

@@ -158,10 +158,10 @@ def _cooccurrence_mesh(
             out_specs=rep,
         )
     )
-    from predictionio_tpu.parallel.mesh import fetch_global, put_row_global
+    from predictionio_tpu.parallel.mesh import fetch_global, put_global
 
     sharding = NamedSharding(mesh, row)
-    put = lambda a: put_row_global(sharding, a)
+    put = lambda a: put_global(a, sharding)
     return fetch_global(fn(put(idx_p), put(msk_p), put(idx_o), put(msk_o)))
 
 

@@ -284,16 +284,12 @@ def als_fit(
         )
 
     from predictionio_tpu.parallel.mesh import fetch_global as fetch
-    from predictionio_tpu.parallel.mesh import put_row_global
+    from predictionio_tpu.parallel.mesh import put_global
 
     row = NamedSharding(mesh, PartitionSpec("data"))
-    # multi-host: every process loads the same event store; put_row feeds
-    # each process's row slice (row counts are padded to 8*num_shards
-    # multiples, hence divisible by the process count for any mesh built
-    # from jax.devices() order)
-    put_row = lambda a: put_row_global(
-        row, a, advice="build_als_data with num_shards = the mesh's data-axis size"
-    )
+    # multi-host: every process loads the same event store; put_global
+    # feeds each exactly its addressable row shards
+    put_row = lambda a: put_global(a, row)
 
     u_idx = put_row(data.by_row.indices)
     u_val = put_row(data.by_row.values)

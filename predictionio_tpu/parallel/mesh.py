@@ -29,29 +29,6 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
-def put_row_global(sharding: NamedSharding, a, advice: str = ""):
-    """Row-sharded global array that also works on MULTI-PROCESS meshes.
-
-    Single process: a plain sharded device_put. Multi process: every
-    process is assumed to hold the SAME full array (each read the same
-    event store), so each contributes only its row slice via
-    ``make_array_from_process_local_data``.
-    """
-    n_proc = jax.process_count()
-    if n_proc == 1:
-        return jax.device_put(a, sharding)
-    if a.shape[0] % n_proc:
-        raise ValueError(
-            f"{a.shape[0]} rows do not divide across {n_proc} processes"
-            + (f" -- {advice}" if advice else "")
-        )
-    per = a.shape[0] // n_proc
-    pid = jax.process_index()
-    return jax.make_array_from_process_local_data(
-        sharding, a[pid * per : (pid + 1) * per]
-    )
-
-
 def fetch_global(arr) -> np.ndarray:
     """Host copy of a (possibly multi-process) sharded array: allgathers
     across processes when local devices cannot address every shard."""
@@ -60,6 +37,20 @@ def fetch_global(arr) -> np.ndarray:
 
         return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
     return np.asarray(arr)
+
+
+def put_global(a, sharding: NamedSharding):
+    """Place a host array every process holds IN FULL (each read the same
+    event store / initialized from the same seed) onto a possibly
+    multi-process sharding: each process contributes exactly its
+    addressable shards. The callback form handles ANY spec -- row shards,
+    model-axis parameter shards, replicated, and meshes where a sharded
+    axis does not span processes (per-process slicing by rank would feed
+    those wrong-sized shards)."""
+    if jax.process_count() == 1:
+        return jax.device_put(a, sharding)
+    host = np.asarray(a)
+    return jax.make_array_from_callback(host.shape, sharding, lambda idx: host[idx])
 
 
 def shard_examples(mesh: Mesh | None, x, y):

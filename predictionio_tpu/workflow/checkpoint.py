@@ -137,9 +137,29 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         os.makedirs(self.path, exist_ok=True)
+        option_kwargs: dict = {}
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                # only rank 0 holds a manager (context.checkpoint_manager);
+                # without this, orbax's construction/save/close barriers
+                # wait on ALL jax processes and rank 0 deadlocks. orbax
+                # refuses create=True with active_processes -- the makedirs
+                # above already created the root
+                option_kwargs["multiprocessing_options"] = (
+                    ocp.options.MultiprocessingOptions(
+                        active_processes={0}, primary_host=0
+                    )
+                )
+                option_kwargs["create"] = False
+        except Exception:
+            pass
         self._manager = ocp.CheckpointManager(
             self.path,
-            options=ocp.CheckpointManagerOptions(max_to_keep=self._max_to_keep),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=self._max_to_keep, **option_kwargs
+            ),
         )
 
     def reset(self) -> None:

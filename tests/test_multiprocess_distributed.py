@@ -143,6 +143,104 @@ def test_two_process_als_matches_single_process(tmp_path):
     np.testing.assert_allclose(got["items"], ref.item_factors, atol=2e-2)
 
 
+_NCF_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from predictionio_tpu.parallel.distributed import init_distributed, build_mesh
+    from predictionio_tpu.models.ncf.model import NCFConfig, train_ncf
+    import numpy as np
+
+    pid = int(sys.argv[1])
+    assert init_distributed({coord!r}, 2, pid)
+    mesh = build_mesh([4, 2], ("data", "model"))  # dp x tp across processes
+    rng = np.random.default_rng(31)
+    n = 64
+    config = NCFConfig(num_users=12, num_items=20, embed_dim=4, hidden=(8, 4),
+                       epochs=2, batch_size=16, seed=5)
+    # rank-0-only checkpoint manager, like ctx.checkpoint_manager on a pod:
+    # the per-epoch save must not deadlock waiting on rank 1
+    checkpoint = None
+    if pid == 0:
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+        checkpoint = CheckpointManager("ncf-mp", base_dir={ckpt!r}, fresh=True)
+    params, _ = train_ncf(
+        config,
+        rng.integers(0, 12, size=n).astype(np.int32),
+        rng.integers(0, 20, size=n).astype(np.int32),
+        rng.random(n).astype(np.float32),
+        mesh,
+        checkpoint=checkpoint,
+    )
+    if checkpoint is not None:
+        assert checkpoint.latest_step() == config.epochs - 1
+        checkpoint.close()
+    leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
+    assert all(np.isfinite(l).all() for l in leaves)
+    if pid == 0:
+        np.savez({out!r}, **{{"gmf": params["gmf_user"]["embedding"]}})
+    print("OK", flush=True)
+    """
+)
+
+
+def test_two_process_ncf_train(tmp_path):
+    """NCF dp x tp across two OS processes: parameters (tp-sharded over the
+    model axis) place via per-process shards, every batch feeds through
+    make_array_from_process_local_data, and the gradient psums cross the
+    process boundary. The trained embedding must match a single-process
+    run on the same data."""
+    import numpy as np
+    import predictionio_tpu
+
+    repo = str(next(iter(predictionio_tpu.__path__)) + "/..")
+    out = tmp_path / "ncf.npz"
+    script = tmp_path / "ncf_worker.py"
+    script.write_text(
+        _NCF_WORKER.format(
+            repo=repo,
+            coord=f"127.0.0.1:{_free_port()}",
+            out=str(out),
+            ckpt=str(tmp_path / "ckpts"),
+        )
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, text in zip(procs, outs):
+        assert p.returncode == 0, text
+        assert "OK" in text
+
+    from predictionio_tpu.models.ncf.model import NCFConfig, train_ncf
+    from predictionio_tpu.parallel.mesh import local_mesh
+
+    rng = np.random.default_rng(31)
+    n = 64
+    config = NCFConfig(num_users=12, num_items=20, embed_dim=4, hidden=(8, 4),
+                       epochs=2, batch_size=16, seed=5)
+    ref_params, _ = train_ncf(
+        config,
+        rng.integers(0, 12, size=n).astype(np.int32),
+        rng.integers(0, 20, size=n).astype(np.int32),
+        rng.random(n).astype(np.float32),
+        local_mesh(4, 2),
+    )
+    got = np.load(out)
+    np.testing.assert_allclose(
+        got["gmf"], np.asarray(ref_params["gmf_user"]["embedding"]), atol=1e-4
+    )
+
+
 _COOC_WORKER = textwrap.dedent(
     """
     import os, sys
