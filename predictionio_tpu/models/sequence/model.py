@@ -26,7 +26,11 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import check_steps_ran
+from predictionio_tpu.parallel.mesh import (
+    check_steps_ran,
+    fetch_global,
+    put_global,
+)
 from predictionio_tpu.ops.flash_attention import flash_attention
 from predictionio_tpu.parallel.ring_attention import plain_attention, ring_attention
 from predictionio_tpu.parallel.ulysses import ulysses_attention
@@ -196,9 +200,12 @@ def train_sasrec(
     dp_axis = "data" if "data" in mesh.axis_names else None
     sp_axis = "seq" if "seq" in mesh.axis_names else None
     seq_shard = NamedSharding(mesh, P(dp_axis, sp_axis))
-    params = jax.device_put(params, rep)
+    # put_global/jitted-init: on multi-process meshes every rank holds
+    # identical params (same PRNGKey); placement and Adam-state creation
+    # must not touch non-addressable shards eagerly
+    params = jax.tree_util.tree_map(lambda a: put_global(a, rep), params)
     optimizer = optax.adam(config.learning_rate)
-    opt_state = optimizer.init(params)
+    opt_state = jax.jit(optimizer.init)(params)
 
     step_fn = jax.jit(
         make_train_step(model, optimizer),
@@ -224,9 +231,11 @@ def train_sasrec(
             if not usable:
                 continue
             take = take[:usable]
+            # identical permutation on every rank (same seed): put_global
+            # hands each process exactly its addressable (data, seq) shards
             batch = {
-                "seq": jnp.asarray(inputs[take]),
-                "target": jnp.asarray(targets[take]),
+                "seq": put_global(inputs[take], seq_shard),
+                "target": put_global(targets[take], seq_shard),
             }
             params, opt_state, loss = step_fn(
                 params, opt_state, batch, jax.random.fold_in(rng, step)
@@ -235,7 +244,7 @@ def train_sasrec(
             if log_every and step % log_every == 0:
                 losses.append(float(loss))
     check_steps_ran(step, n, dp, "sequence")
-    return jax.device_get(params), losses
+    return jax.tree_util.tree_map(fetch_global, params), losses
 
 
 _APPLY_CACHE: dict[SASRecConfig, object] = {}

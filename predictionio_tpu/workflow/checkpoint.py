@@ -138,23 +138,31 @@ class CheckpointManager:
 
         os.makedirs(self.path, exist_ok=True)
         option_kwargs: dict = {}
-        try:
-            import jax
+        import jax
 
-            if jax.process_count() > 1:
-                # only rank 0 holds a manager (context.checkpoint_manager);
-                # without this, orbax's construction/save/close barriers
-                # wait on ALL jax processes and rank 0 deadlocks. orbax
-                # refuses create=True with active_processes -- the makedirs
-                # above already created the root
+        if jax.process_count() > 1:
+            # only rank 0 holds a manager (context.checkpoint_manager);
+            # without this, orbax's construction/save/close barriers wait
+            # on ALL jax processes and rank 0 deadlocks. orbax refuses
+            # create=True with active_processes -- the makedirs above
+            # already created the root
+            try:
                 option_kwargs["multiprocessing_options"] = (
                     ocp.options.MultiprocessingOptions(
                         active_processes={0}, primary_host=0
                     )
                 )
                 option_kwargs["create"] = False
-        except Exception:
-            pass
+            except (AttributeError, TypeError):
+                # older/newer orbax API shape: falling through here builds
+                # the ALL-process manager, which deadlocks rank 0 in a
+                # multi-process train -- make the cause visible first
+                logger.warning(
+                    "this orbax version does not support rank-0-only"
+                    " checkpointing options; multi-process checkpointing"
+                    " may hang",
+                    exc_info=True,
+                )
         self._manager = ocp.CheckpointManager(
             self.path,
             options=ocp.CheckpointManagerOptions(
