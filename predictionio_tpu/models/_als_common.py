@@ -1,0 +1,156 @@
+"""Shared pieces of the ALS-backed templates (recommendation, e-commerce).
+
+One source of truth for the behaviors both ALS templates must agree on:
+mesh-aware CSR packing, the fingerprinted step-checkpoint wiring
+(preemption safety, SURVEY §5.4), the seen-items map, and the rank+format
+tail of their ``itemScores`` responses (predict and the vectorized batch
+path must rank identically). The cooccurrence-based templates keep their
+own tails: their exclusion sentinel is 0, not -inf.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+
+import numpy as np
+
+from predictionio_tpu.parallel.als import ALSConfig, ALSModel, als_fit, build_als_data
+
+logger = logging.getLogger("pio.als")
+
+
+def prepare_als_data(
+    ctx,
+    params,
+    users: np.ndarray,
+    items: np.ndarray,
+    values: np.ndarray,
+    num_users: int,
+    num_items: int,
+    times: np.ndarray,
+):
+    """Pack COO interactions into padded CSR blocks sized for ctx's mesh."""
+    config = ALSConfig(max_len=params.get_or("maxEventsPerUser", None))
+    num_shards = 1
+    try:
+        num_shards = ctx.mesh.shape.get("data", 1)
+    except Exception:
+        pass  # no devices available (pure-host tests)
+    return build_als_data(
+        users,
+        items,
+        values,
+        num_users,
+        num_items,
+        config,
+        times=times,
+        num_shards=num_shards,
+    )
+
+
+def build_seen(users: np.ndarray, items: np.ndarray) -> dict[int, set[int]]:
+    """user index -> set of interacted item indices (serving-time filter)."""
+    seen: dict[int, set[int]] = {}
+    for u, i in zip(users, items):
+        seen.setdefault(int(u), set()).add(int(i))
+    return seen
+
+
+def topk_item_scores(item_ids: list[str], scores: np.ndarray, num: int) -> dict:
+    """Rank + format tail shared by every template response: descending
+    top-``num``, excluded entries carried as -inf and dropped here."""
+    order = np.argsort(-scores)[:num]
+    return {
+        "itemScores": [
+            {"item": item_ids[j], "score": float(scores[j])}
+            for j in order
+            if np.isfinite(scores[j])
+        ]
+    }
+
+
+def _vocab_hash(ids: list[str]) -> str:
+    h = hashlib.sha256()
+    for s in ids:
+        h.update(s.encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:16]
+
+
+def fit_with_checkpoint(
+    ctx,
+    als_data,
+    config: ALSConfig,
+    mesh,
+    *,
+    user_ids: list[str],
+    item_ids: list[str],
+    interval: int,
+    name: str = "als",
+) -> ALSModel:
+    """``als_fit`` wrapped in fingerprinted step checkpoints.
+
+    Checkpointed factors are only meaningful against the id vocabularies
+    they were trained on. Events ingested between crash and resume change
+    num_users/num_items -- restoring would crash on shape mismatch or
+    silently misalign factor rows with the new vocabulary. Counts alone
+    are not enough (delete one user + add another keeps the count but
+    renumbers rows), so the vocabularies themselves are hashed too. A
+    mismatch discards the checkpoints and trains fresh with a warning.
+
+    ``interval`` <= 0 disables checkpointing entirely.
+    """
+    checkpoint = ctx.checkpoint_manager(name) if interval > 0 else None
+    init, start_iteration, callback = None, 0, None
+    if checkpoint is not None:
+        num_users, num_items = len(user_ids), len(item_ids)
+        fingerprint = {
+            "num_users": num_users,
+            "num_items": num_items,
+            "user_vocab": _vocab_hash(user_ids),
+            "item_vocab": _vocab_hash(item_ids),
+            "rank": config.rank,
+        }
+        latest = checkpoint.latest_step()
+        if latest is not None:  # only a --resume run can see a step here
+            meta = checkpoint.read_meta()
+            if meta != fingerprint:
+                logger.warning(
+                    "%s checkpoint fingerprint %s does not match current"
+                    " dataset %s (events changed between crash and resume?);"
+                    " discarding checkpoints and training fresh",
+                    name,
+                    meta,
+                    fingerprint,
+                )
+                checkpoint.reset()
+            else:
+                state = checkpoint.restore(
+                    {
+                        "users": np.zeros((num_users, config.rank), np.float32),
+                        "items": np.zeros((num_items, config.rank), np.float32),
+                        "iteration": 0,
+                    }
+                )
+                init = (state["users"], state["items"])
+                start_iteration = int(state["iteration"]) + 1
+        checkpoint.write_meta(fingerprint)
+
+        def callback(it, users_np, items_np):
+            checkpoint.save(
+                it, {"users": users_np, "items": items_np, "iteration": it}
+            )
+
+    model = als_fit(
+        als_data,
+        config,
+        mesh,
+        callback=callback,
+        callback_interval=interval,
+        init=init,
+        start_iteration=start_iteration,
+    )
+    if checkpoint is not None:
+        checkpoint.close()
+    return model

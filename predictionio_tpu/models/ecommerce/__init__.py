@@ -1,0 +1,3 @@
+from predictionio_tpu.models.ecommerce.engine import engine_factory
+
+__all__ = ["engine_factory"]

@@ -1,0 +1,346 @@
+"""DASE components of the e-commerce recommendation template.
+
+The fourth stock template of the reference's model zoo (SURVEY.md §2.5 #37:
+``predictionio-template-ecom-recommender``): implicit-feedback ALS over
+view/buy events, with the business rules the plain recommendation template
+lacks, applied at serving time:
+
+- ``categories`` filter (item properties ingested via ``$set`` events),
+- ``whiteList`` / ``blackList`` in the query,
+- a live *unavailable items* constraint: a ``$set`` on the constraint
+  entity ``unavailableItems`` read from the event store **per query**, so
+  ops can pull items from every deployed server without retraining,
+- cold-start users served from their recently-viewed items (also a live
+  event-store read), scored through ALS item-space similarity.
+
+Query contract:
+``{"user": "u1", "num": 4, "categories": [...], "whiteList": [...],
+"blackList": [...]}`` -> ``{"itemScores": [{"item": ..., "score": ...}]}``.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    EvalInfo,
+    FirstServing,
+    Preparator,
+    TPUAlgorithm,
+)
+from predictionio_tpu.controller.base import SanityCheck
+from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.models._als_common import (
+    build_seen,
+    fit_with_checkpoint,
+    prepare_als_data,
+    topk_item_scores,
+)
+from predictionio_tpu.parallel.als import ALSConfig, ALSModel
+
+logger = logging.getLogger("pio.ecommerce")
+
+
+@dataclass
+class ECommerceData(SanityCheck):
+    """Implicit interactions + per-item categories from ``$set`` properties."""
+
+    users: np.ndarray
+    items: np.ndarray
+    weights: np.ndarray      # buy-weighted implicit confidence
+    times: np.ndarray
+    user_ids: list[str]
+    item_ids: list[str]
+    app_name: str = ""       # carried to the model for live serving reads
+    categories: dict[str, list[str]] = field(default_factory=dict)
+
+    def sanity_check(self) -> None:
+        if self.users.size == 0:
+            raise ValueError("no view/buy events found -- check appName")
+
+
+class ECommerceDataSource(DataSource):
+    """Params: appName (required), eventNames (default ["view", "buy"]),
+    buyEvents (exact event names carrying purchase-strength confidence,
+    default ["buy"]), buyWeight (their confidence multiplier, default 2.0)."""
+
+    def _read(self) -> ECommerceData:
+        event_names = self.params.get_or("eventNames", ["view", "buy"])
+        ds = PEventStore.dataset(
+            self.params.appName,
+            event_names=event_names,
+            target_entity_type="item",
+        )
+        valid = ds.target_entity_ids >= 0
+        # implicit confidence: views count 1, buys count more.
+        # event_names is dictionary-encoded -- match codes, not strings;
+        # exact names only (substring matching would give "unbuy"-style
+        # cancellation events the purchase boost)
+        buy_weight = float(self.params.get_or("buyWeight", 2.0))
+        buy_events = set(self.params.get_or("buyEvents", ["buy"]))
+        weights = np.ones(int(valid.sum()), dtype=np.float32)
+        buy_codes = [
+            code
+            for code, name in enumerate(ds.event_name_vocab)
+            if name in buy_events
+        ]
+        weights[np.isin(ds.event_names[valid], buy_codes)] = buy_weight
+        props = PEventStore.aggregate_properties(self.params.appName, "item")
+        categories = {
+            item_id: list(pm.get("categories", []) or [])
+            for item_id, pm in props.items()
+            if pm.get("categories", None)
+        }
+        return ECommerceData(
+            users=ds.entity_ids[valid],
+            items=ds.target_entity_ids[valid],
+            weights=weights,
+            times=ds.event_times[valid],
+            user_ids=ds.entity_id_vocab,
+            item_ids=ds.target_entity_id_vocab,
+            app_name=self.params.appName,
+            categories=categories,
+        )
+
+    def read_training(self, ctx) -> ECommerceData:
+        return self._read()
+
+    def read_eval(self, ctx):
+        """Hold out each user's latest interaction as the actual."""
+        data = self._read()
+        data.sanity_check()
+        order = np.lexsort((data.times, data.users))
+        users, items = data.users[order], data.items[order]
+        last = np.r_[users[1:] != users[:-1], True]
+        train = ECommerceData(
+            users=users[~last],
+            items=items[~last],
+            weights=data.weights[order][~last],
+            times=data.times[order][~last],
+            user_ids=data.user_ids,
+            item_ids=data.item_ids,
+            app_name=data.app_name,
+            categories=data.categories,
+        )
+        pairs = [
+            (
+                {"user": data.user_ids[int(u)], "num": self.params.get_or("evalK", 10)},
+                [data.item_ids[int(i)]],
+            )
+            for u, i in zip(users[last], items[last])
+        ]
+        return [(train, EvalInfo(fold=0), pairs)]
+
+
+class ECommercePreparator(Preparator):
+    """Packs interactions into mesh-sized padded CSR blocks (ALX layout)."""
+
+    def prepare(self, ctx, data: ECommerceData):
+        als_data = prepare_als_data(
+            ctx,
+            self.params,
+            data.users,
+            data.items,
+            data.weights,
+            len(data.user_ids),
+            len(data.item_ids),
+            times=data.times,
+        )
+        return data, als_data
+
+
+@dataclass
+class ECommerceModel:
+    """Host-cached factors + the inverted category index for O(1) filters."""
+
+    als: ALSModel
+    app_name: str
+    user_index: dict[str, int]
+    item_ids: list[str]
+    item_index: dict[str, int]
+    seen: dict[int, set[int]]
+    #: category -> sorted item indices (query-time mask building)
+    category_items: dict[str, np.ndarray]
+    similar_events: list[str]
+
+
+class ECommAlgorithm(TPUAlgorithm):
+    """Implicit ALS + serving-time business rules.
+
+    Params: rank, numIterations, lambda, alpha, seed, unseenOnly (default
+    True), similarEvents (events anchoring cold users, default ["view"]),
+    recentCount (how many recent views to anchor on, default 10; a query
+    may override it), checkpointInterval (iterations between step
+    checkpoints; 0 disables).
+    """
+
+    def _config(self) -> ALSConfig:
+        p = self.params
+        return ALSConfig(
+            rank=p.get_or("rank", 16),
+            iterations=p.get_or("numIterations", 10),
+            reg=p.get_or("lambda", 0.05),
+            alpha=p.get_or("alpha", 10.0),
+            implicit=p.get_or("implicitPrefs", True),
+            seed=p.get_or("seed", 0),
+            dtype=p.get_or("factorDtype", "float32"),
+        )
+
+    def train(self, ctx, prepared) -> ECommerceModel:
+        data, als_data = prepared
+        model = fit_with_checkpoint(
+            ctx,
+            als_data,
+            self._config(),
+            self.mesh_or_none(ctx),
+            user_ids=data.user_ids,
+            item_ids=data.item_ids,
+            interval=self.params.get_or("checkpointInterval", 5),
+            name="ecomm-als",
+        )
+        seen = build_seen(data.users, data.items)
+        item_index = {iid: j for j, iid in enumerate(data.item_ids)}
+        by_cat: dict[str, list[int]] = {}
+        for item_id, cats in data.categories.items():
+            j = item_index.get(item_id)
+            if j is not None:
+                for c in cats:
+                    by_cat.setdefault(str(c), []).append(j)
+        return ECommerceModel(
+            als=model,
+            app_name=self.params.get_or("appName", None) or data.app_name,
+            user_index={uid: k for k, uid in enumerate(data.user_ids)},
+            item_ids=data.item_ids,
+            item_index=item_index,
+            seen=seen,
+            category_items={
+                c: np.asarray(sorted(js), dtype=np.int64) for c, js in by_cat.items()
+            },
+            similar_events=self.params.get_or("similarEvents", ["view"]),
+        )
+
+    # ------------------------------------------------------------------
+    # serving
+    # ------------------------------------------------------------------
+
+    def _unavailable_items(self, model: ECommerceModel) -> set[int]:
+        """Latest ``$set`` on constraint entity ``unavailableItems``, read
+        live so deployed servers react without retraining. Any storage
+        error degrades to "nothing unavailable" (serving must not 500
+        because the metadata store blinked)."""
+        if not model.app_name:
+            return set()
+        try:
+            events = list(
+                LEventStore.find_by_entity(
+                    model.app_name,
+                    entity_type="constraint",
+                    entity_id="unavailableItems",
+                    event_names=["$set"],
+                    limit=1,
+                    latest=True,
+                )
+            )
+        except Exception:
+            logger.warning("unavailableItems lookup failed; serving unfiltered",
+                           exc_info=True)
+            return set()
+        if not events:
+            return set()
+        items = events[0].properties.get("items", []) or []
+        return {
+            model.item_index[str(i)] for i in items if str(i) in model.item_index
+        }
+
+    def _recently_viewed(self, model: ECommerceModel, user: str, count: int) -> list[int]:
+        """Cold-user anchors: the user's latest ``similarEvents`` items."""
+        if not model.app_name:
+            return []
+        try:
+            events = LEventStore.find_by_entity(
+                model.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=model.similar_events,
+                limit=count,
+                latest=True,
+            )
+        except Exception:
+            logger.warning("recent-view lookup failed for user %r", user,
+                           exc_info=True)
+            return []
+        out = []
+        for e in events:
+            j = model.item_index.get(str(e.target_entity_id))
+            if j is not None and j not in out:
+                out.append(j)
+        return out
+
+    def predict(self, model: ECommerceModel, query) -> dict:
+        num = int(query.get("num", 10))
+        user = str(query.get("user", ""))
+        if not user:
+            raise ValueError("query must contain 'user'")
+        user_idx = model.user_index.get(user)
+        anchors: list[int] = []
+        if user_idx is not None:
+            scores = model.als.score_items_for_user(user_idx)
+        else:
+            # cold user: anchor on live recently-viewed items; a user with
+            # no history at all gets empty (reference behavior)
+            anchors = self._recently_viewed(
+                model,
+                user,
+                int(query.get("recentCount", self.params.get_or("recentCount", 10))),
+            )
+            if not anchors:
+                return {"itemScores": []}
+            scores = np.zeros(len(model.item_ids), dtype=np.float32)
+            for a in anchors:
+                scores += model.als.similar_items(a)
+
+        # --- business rules -------------------------------------------
+        n_items = scores.shape[0]
+        if query.get("whiteList"):
+            allowed = np.zeros(n_items, dtype=bool)
+            for w in query["whiteList"]:
+                j = model.item_index.get(str(w))
+                if j is not None:
+                    allowed[j] = True
+        else:
+            allowed = np.ones(n_items, dtype=bool)
+        if query.get("categories"):
+            cat_mask = np.zeros(n_items, dtype=bool)
+            for c in query["categories"]:
+                idxs = model.category_items.get(str(c))
+                if idxs is not None:
+                    cat_mask[idxs] = True
+            allowed &= cat_mask
+        exclude: set[int] = set(anchors)
+        for b in query.get("blackList") or []:
+            j = model.item_index.get(str(b))
+            if j is not None:
+                exclude.add(j)
+        exclude |= self._unavailable_items(model)
+        if user_idx is not None and query.get(
+            "unseenOnly", self.params.get_or("unseenOnly", True)
+        ):
+            exclude |= model.seen.get(user_idx, set())
+        scores = np.where(allowed, scores, -np.inf)
+        for j in exclude:
+            scores[j] = -np.inf
+        return topk_item_scores(model.item_ids, scores, num)
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=ECommerceDataSource,
+        preparator_class=ECommercePreparator,
+        algorithm_class_map={"ecomm": ECommAlgorithm},
+        serving_class=FirstServing,
+    )

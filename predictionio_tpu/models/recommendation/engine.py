@@ -22,12 +22,13 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.controller.base import SanityCheck
 from predictionio_tpu.data.store import PEventStore
-from predictionio_tpu.parallel.als import (
-    ALSConfig,
-    ALSModel,
-    als_fit,
-    build_als_data,
+from predictionio_tpu.models._als_common import (
+    build_seen,
+    fit_with_checkpoint,
+    prepare_als_data,
+    topk_item_scores,
 )
+from predictionio_tpu.parallel.als import ALSConfig, ALSModel
 
 logger = logging.getLogger("pio.recommendation")
 
@@ -123,21 +124,15 @@ class RecommendationPreparator(Preparator):
     """Packs COO ratings into padded CSR blocks sized for the mesh."""
 
     def prepare(self, ctx, training_data: RatingsData):
-        config = ALSConfig(max_len=self.params.get_or("maxEventsPerUser", None))
-        num_shards = 1
-        try:
-            num_shards = ctx.mesh.shape.get("data", 1)
-        except Exception:
-            pass  # no devices available (pure-host tests)
-        als_data = build_als_data(
+        als_data = prepare_als_data(
+            ctx,
+            self.params,
             training_data.users,
             training_data.items,
             training_data.ratings,
             training_data.num_users,
             training_data.num_items,
-            config,
             times=training_data.times,
-            num_shards=num_shards,
         )
         return training_data, als_data
 
@@ -181,83 +176,16 @@ class ALSAlgorithm(TPUAlgorithm):
 
     def train(self, ctx, prepared) -> RecommendationModel:
         ratings_data, als_data = prepared
-        config = self._config()
-        mesh = self.mesh_or_none(ctx)
-        interval = self.params.get_or("checkpointInterval", 5)
-        checkpoint = ctx.checkpoint_manager("als") if interval > 0 else None
-        init, start_iteration, callback = None, 0, None
-        if checkpoint is not None:
-            # dataset fingerprint: checkpointed factors are only meaningful
-            # against the id vocabularies they were trained on. Events
-            # ingested between crash and resume change num_users/num_items
-            # -- restoring would crash on shape mismatch or silently
-            # misalign factor rows with the new vocabulary. Counts alone
-            # are not enough (delete one user + add another keeps the count
-            # but renumbers rows), so the vocabularies themselves are
-            # hashed too.
-            import hashlib
-
-            def vocab_hash(ids: list[str]) -> str:
-                h = hashlib.sha256()
-                for s in ids:
-                    h.update(s.encode())
-                    h.update(b"\x00")
-                return h.hexdigest()[:16]
-
-            fingerprint = {
-                "num_users": ratings_data.num_users,
-                "num_items": ratings_data.num_items,
-                "user_vocab": vocab_hash(ratings_data.user_ids),
-                "item_vocab": vocab_hash(ratings_data.item_ids),
-                "rank": config.rank,
-            }
-            latest = checkpoint.latest_step()
-            if latest is not None:  # only a --resume run can see a step here
-                meta = checkpoint.read_meta()
-                if meta != fingerprint:
-                    logger.warning(
-                        "als checkpoint fingerprint %s does not match current"
-                        " dataset %s (events changed between crash and"
-                        " resume?); discarding checkpoints and training fresh",
-                        meta,
-                        fingerprint,
-                    )
-                    checkpoint.reset()
-                else:
-                    state = checkpoint.restore(
-                        {
-                            "users": np.zeros(
-                                (ratings_data.num_users, config.rank), np.float32
-                            ),
-                            "items": np.zeros(
-                                (ratings_data.num_items, config.rank), np.float32
-                            ),
-                            "iteration": 0,
-                        }
-                    )
-                    init = (state["users"], state["items"])
-                    start_iteration = int(state["iteration"]) + 1
-            checkpoint.write_meta(fingerprint)
-
-            def callback(it, users_np, items_np):
-                checkpoint.save(
-                    it, {"users": users_np, "items": items_np, "iteration": it}
-                )
-
-        model = als_fit(
+        model = fit_with_checkpoint(
+            ctx,
             als_data,
-            config,
-            mesh,
-            callback=callback,
-            callback_interval=interval,
-            init=init,
-            start_iteration=start_iteration,
+            self._config(),
+            self.mesh_or_none(ctx),
+            user_ids=ratings_data.user_ids,
+            item_ids=ratings_data.item_ids,
+            interval=self.params.get_or("checkpointInterval", 5),
         )
-        if checkpoint is not None:
-            checkpoint.close()
-        seen: dict[int, set[int]] = {}
-        for u, i in zip(ratings_data.users, ratings_data.items):
-            seen.setdefault(int(u), set()).add(int(i))
+        seen = build_seen(ratings_data.users, ratings_data.items)
         return RecommendationModel(
             als=model,
             user_index={uid: idx for idx, uid in enumerate(ratings_data.user_ids)},
@@ -333,14 +261,7 @@ class ALSAlgorithm(TPUAlgorithm):
             exclude |= model.seen.get(user_idx, set())
         for idx in exclude:
             scores[idx] = -np.inf
-        order = np.argsort(-scores)[:num]
-        return {
-            "itemScores": [
-                {"item": model.item_ids[i], "score": float(scores[i])}
-                for i in order
-                if np.isfinite(scores[i])
-            ]
-        }
+        return topk_item_scores(model.item_ids, scores, num)
 
     def _recommend_for_user(self, model: RecommendationModel, query, num: int) -> dict:
         user_idx = model.user_index.get(str(query["user"]))
@@ -363,14 +284,7 @@ class ALSAlgorithm(TPUAlgorithm):
             sims = s if sims is None else sims + s
         for idx in anchors:
             sims[idx] = -np.inf
-        order = np.argsort(-sims)[:num]
-        return {
-            "itemScores": [
-                {"item": model.item_ids[i], "score": float(sims[i])}
-                for i in order
-                if np.isfinite(sims[i])
-            ]
-        }
+        return topk_item_scores(model.item_ids, sims, num)
 
 
 def engine_factory() -> Engine:

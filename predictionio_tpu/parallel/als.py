@@ -24,7 +24,7 @@ observed pairs, preference p = 1; unobserved pairs have c = 1, p = 0.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
@@ -208,6 +208,11 @@ def _build_iteration(mesh, rank: int, reg: float, alpha: float, implicit: bool):
 class ALSModel:
     user_factors: np.ndarray  # [num_users, K]
     item_factors: np.ndarray  # [num_items, K]
+    #: lazily-built catalog norm cache -- similar_items is called once per
+    #: anchor at serving time and must not rescan item_factors every call
+    _item_norms: np.ndarray | None = field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     def score_items_for_user(self, user_index: int) -> np.ndarray:
         return self.item_factors @ self.user_factors[user_index]
@@ -215,10 +220,16 @@ class ALSModel:
     def score_users_for_item(self, item_index: int) -> np.ndarray:
         return self.user_factors @ self.item_factors[item_index]
 
+    @property
+    def item_norms(self) -> np.ndarray:
+        if self._item_norms is None:
+            self._item_norms = np.linalg.norm(self.item_factors, axis=1)
+        return self._item_norms
+
     def similar_items(self, item_index: int) -> np.ndarray:
         """Cosine scores of all items against one (ALS-space similarity)."""
         v = self.item_factors[item_index]
-        norms = np.linalg.norm(self.item_factors, axis=1) * (np.linalg.norm(v) + 1e-12)
+        norms = self.item_norms * (self.item_norms[item_index] + 1e-12)
         return (self.item_factors @ v) / np.maximum(norms, 1e-12)
 
 

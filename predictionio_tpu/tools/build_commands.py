@@ -169,6 +169,7 @@ _TEMPLATE_BLURBS = {
     "recommendation": "ALS matrix factorization (MLlib recommender parity)",
     "classification": "Naive Bayes / logistic regression (classification parity)",
     "similarproduct": "item cooccurrence similar-product recommender",
+    "ecommerce": "implicit ALS + live business rules (categories, stock)",
     "universal": "Universal-Recommender-style LLR cross-occurrence",
     "ncf": "Neural Collaborative Filtering (NeuMF) on the dp x tp mesh",
     "sequence": "SASRec sequential recommender (ring-attention sp mesh)",

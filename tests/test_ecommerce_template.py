@@ -1,0 +1,184 @@
+"""E-commerce template tests (SURVEY §2.5 #37 ecom-recommender): implicit
+ALS plus the serving-time business rules that distinguish it from the plain
+recommendation template -- category filters, white/black lists, the live
+unavailable-items constraint entity, and cold users served from recently
+viewed items."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.models.ecommerce import engine_factory
+from predictionio_tpu.workflow.context import RuntimeContext
+
+
+@pytest.fixture()
+def shop_app(storage_env):
+    """Two cliques: electronics buyers (e*) and clothing buyers (c*). Items
+    carry $set categories; buys outweigh views."""
+    app_id = storage_env.get_meta_data_apps().insert(App(name="ShopApp"))
+    le = storage_env.get_l_events()
+    le.init_channel(app_id)
+    rng = np.random.default_rng(11)
+    electronics = [f"e{i}" for i in range(6)]
+    clothing = [f"c{i}" for i in range(6)]
+    events = []
+    for item in electronics:
+        events.append(
+            Event(event="$set", entity_type="item", entity_id=item,
+                  properties=DataMap({"categories": ["electronics"]}))
+        )
+    for item in clothing:
+        events.append(
+            Event(event="$set", entity_type="item", entity_id=item,
+                  properties=DataMap({"categories": ["clothing"]}))
+        )
+    for g, liked in enumerate([electronics, clothing]):
+        for u in range(8):
+            user = f"g{g}u{u}"
+            for item in rng.choice(liked, size=4, replace=False):
+                events.append(
+                    Event(event="buy", entity_type="user", entity_id=user,
+                          target_entity_type="item", target_entity_id=str(item))
+                )
+            for item in rng.choice(liked, size=2, replace=False):
+                events.append(
+                    Event(event="view", entity_type="user", entity_id=user,
+                          target_entity_type="item", target_entity_id=str(item))
+                )
+    le.batch_insert(events, app_id=app_id)
+    return app_id
+
+
+def make_params(**algo):
+    algo.setdefault("rank", 8)
+    algo.setdefault("numIterations", 8)
+    algo.setdefault("seed", 3)
+    return EngineParams.from_json_obj(
+        {
+            "datasource": {"params": {"appName": "ShopApp"}},
+            "algorithms": [{"name": "ecomm", "params": algo}],
+        }
+    )
+
+
+def train(params):
+    engine = engine_factory()
+    ctx = RuntimeContext()
+    models = engine.train(ctx, params)
+    algo = engine._algorithms(params)[0]
+    return algo, models[0]
+
+
+class TestECommerceEngine:
+    def test_recommends_in_clique(self, shop_app):
+        algo, model = train(make_params())
+        result = algo.predict(model, {"user": "g0u0", "num": 3, "unseenOnly": False})
+        items = [s["item"] for s in result["itemScores"]]
+        assert items and all(i.startswith("e") for i in items), items
+        scores = [s["score"] for s in result["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_category_filter(self, shop_app):
+        algo, model = train(make_params())
+        # an electronics user constrained to clothing must get only c*
+        result = algo.predict(
+            model,
+            {"user": "g0u0", "num": 4, "categories": ["clothing"]},
+        )
+        items = [s["item"] for s in result["itemScores"]]
+        assert items and all(i.startswith("c") for i in items), items
+        # unknown category -> nothing matches
+        empty = algo.predict(
+            model, {"user": "g0u0", "num": 4, "categories": ["nope"]}
+        )
+        assert empty["itemScores"] == []
+
+    def test_white_and_black_lists(self, shop_app):
+        algo, model = train(make_params())
+        white = algo.predict(
+            model,
+            {"user": "g0u0", "num": 10, "whiteList": ["e0", "e1"],
+             "unseenOnly": False},
+        )
+        assert {s["item"] for s in white["itemScores"]} <= {"e0", "e1"}
+        black = algo.predict(
+            model,
+            {"user": "g0u0", "num": 12, "blackList": ["e0"], "unseenOnly": False},
+        )
+        assert "e0" not in {s["item"] for s in black["itemScores"]}
+
+    def test_unavailable_items_constraint_live(self, shop_app, storage_env):
+        """$set on constraint/unavailableItems removes items from serving
+        WITHOUT retraining; a newer $set replaces the whole list."""
+        algo, model = train(make_params())
+        before = algo.predict(model, {"user": "g0u0", "num": 12, "unseenOnly": False})
+        assert "e0" in {s["item"] for s in before["itemScores"]}
+        le = storage_env.get_l_events()
+        le.insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties=DataMap({"items": ["e0", "e1"]})),
+            app_id=shop_app,
+        )
+        after = algo.predict(model, {"user": "g0u0", "num": 12, "unseenOnly": False})
+        assert {"e0", "e1"}.isdisjoint({s["item"] for s in after["itemScores"]})
+        # replace the constraint: only the latest $set applies
+        le.insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties=DataMap({"items": []})),
+            app_id=shop_app,
+        )
+        restored = algo.predict(
+            model, {"user": "g0u0", "num": 12, "unseenOnly": False}
+        )
+        assert "e0" in {s["item"] for s in restored["itemScores"]}
+
+    def test_cold_user_from_recent_views(self, shop_app, storage_env):
+        """A user unseen at training time is served from their post-training
+        view events (live read), anchored via ALS item similarity."""
+        algo, model = train(make_params())
+        le = storage_env.get_l_events()
+        for item in ["e0", "e2"]:
+            le.insert(
+                Event(event="view", entity_type="user", entity_id="brandnew",
+                      target_entity_type="item", target_entity_id=item),
+                app_id=shop_app,
+            )
+        result = algo.predict(model, {"user": "brandnew", "num": 3})
+        items = [s["item"] for s in result["itemScores"]]
+        assert items, "cold user with views must get recommendations"
+        # anchors themselves are excluded
+        assert {"e0", "e2"}.isdisjoint(items)
+        # a user with no events at all gets empty, not an error
+        none = algo.predict(model, {"user": "ghost", "num": 3})
+        assert none["itemScores"] == []
+
+    def test_unseen_only_default_filters_bought(self, shop_app):
+        algo, model = train(make_params())
+        bought = {
+            i
+            for u, items in model.seen.items()
+            if model.user_index.get("g0u0") == u
+            for i in items
+        }
+        result = algo.predict(model, {"user": "g0u0", "num": 12})
+        got = {model.item_index[s["item"]] for s in result["itemScores"]}
+        assert bought.isdisjoint(got)
+
+    def test_eval_pairs_shape(self, shop_app):
+        from predictionio_tpu.models.ecommerce.engine import ECommerceDataSource
+
+        params = make_params()
+        ctx = RuntimeContext()
+        ds = ECommerceDataSource(params.data_source_params)
+        full = ds.read_training(ctx)
+        folds = ds.read_eval(ctx)
+        assert len(folds) == 1
+        train_data, info, pairs = folds[0]
+        assert pairs and all("user" in q for q, _ in pairs)
+        # exactly one held-out interaction per user
+        assert train_data.users.size + len(pairs) == full.users.size

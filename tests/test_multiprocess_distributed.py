@@ -44,14 +44,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_psum(tmp_path):
+def _repo_root() -> str:
     import predictionio_tpu
 
-    repo = str(next(iter(predictionio_tpu.__path__)) + "/..")
-    script = tmp_path / "worker.py"
-    script.write_text(
-        _WORKER.format(repo=repo, coord=f"127.0.0.1:{_free_port()}")
-    )
+    return str(next(iter(predictionio_tpu.__path__)) + "/..")
+
+
+def _run_workers(script, timeout: float = 240, n: int = 2) -> None:
+    """Launch ``script`` as n cooperating processes (argv[1] = process id)
+    and assert each exits 0 printing OK. A timeout kills ALL workers (a
+    hung coordinator must not leak its sibling into later tests)."""
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(pid)],
@@ -59,12 +61,26 @@ def test_two_process_psum(tmp_path):
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for pid in (0, 1)
+        for pid in range(n)
     ]
-    outs = [p.communicate(timeout=180)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
         assert "OK" in out
+
+
+def test_two_process_psum(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        _WORKER.format(repo=_repo_root(), coord=f"127.0.0.1:{_free_port()}")
+    )
+    _run_workers(script, timeout=180)
 
 
 _ALS_WORKER = textwrap.dedent(
@@ -104,27 +120,15 @@ def test_two_process_als_matches_single_process(tmp_path):
     match a single-process train on the same data -- the reference's
     NCCL/MPI-style scaling story, actually executed (SURVEY 5.8)."""
     import numpy as np
-    import predictionio_tpu
 
-    repo = str(next(iter(predictionio_tpu.__path__)) + "/..")
     out = tmp_path / "factors.npz"
     script = tmp_path / "als_worker.py"
     script.write_text(
-        _ALS_WORKER.format(repo=repo, coord=f"127.0.0.1:{_free_port()}", out=str(out))
-    )
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(pid)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
+        _ALS_WORKER.format(
+            repo=_repo_root(), coord=f"127.0.0.1:{_free_port()}", out=str(out)
         )
-        for pid in (0, 1)
-    ]
-    outs = [p.communicate(timeout=240)[0] for p in procs]
-    for p, text in zip(procs, outs):
-        assert p.returncode == 0, text
-        assert "OK" in text
+    )
+    _run_workers(script)
 
     # single-process reference on the same data and an 8-way local mesh
     from predictionio_tpu.parallel.als import ALSConfig, als_fit, build_als_data
@@ -194,32 +198,18 @@ def test_two_process_ncf_train(tmp_path):
     process boundary. The trained embedding must match a single-process
     run on the same data."""
     import numpy as np
-    import predictionio_tpu
 
-    repo = str(next(iter(predictionio_tpu.__path__)) + "/..")
     out = tmp_path / "ncf.npz"
     script = tmp_path / "ncf_worker.py"
     script.write_text(
         _NCF_WORKER.format(
-            repo=repo,
+            repo=_repo_root(),
             coord=f"127.0.0.1:{_free_port()}",
             out=str(out),
             ckpt=str(tmp_path / "ckpts"),
         )
     )
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(pid)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for pid in (0, 1)
-    ]
-    outs = [p.communicate(timeout=240)[0] for p in procs]
-    for p, text in zip(procs, outs):
-        assert p.returncode == 0, text
-        assert "OK" in text
+    _run_workers(script)
 
     from predictionio_tpu.models.ncf.model import NCFConfig, train_ncf
     from predictionio_tpu.parallel.mesh import local_mesh
@@ -279,27 +269,15 @@ def test_two_process_sasrec_train(tmp_path):
     process boundary, so ring attention's ppermute K/V hops actually cross
     processes. Trained embeddings must match a single-process run."""
     import numpy as np
-    import predictionio_tpu
 
-    repo = str(next(iter(predictionio_tpu.__path__)) + "/..")
     out = tmp_path / "sasrec.npz"
     script = tmp_path / "sasrec_worker.py"
     script.write_text(
-        _SASREC_WORKER.format(repo=repo, coord=f"127.0.0.1:{_free_port()}", out=str(out))
-    )
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(pid)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
+        _SASREC_WORKER.format(
+            repo=_repo_root(), coord=f"127.0.0.1:{_free_port()}", out=str(out)
         )
-        for pid in (0, 1)
-    ]
-    outs = [p.communicate(timeout=240)[0] for p in procs]
-    for p, text in zip(procs, outs):
-        assert p.returncode == 0, text
-        assert "OK" in text
+    )
+    _run_workers(script)
 
     from jax.sharding import Mesh
 
@@ -352,23 +330,8 @@ def test_two_process_cooccurrence(tmp_path):
     """Sharded cooccurrence across two OS processes: each feeds its user
     rows, the psum crosses the process boundary, and every process gets
     the full (replicated) [items, items] result."""
-    import predictionio_tpu
-
-    repo = str(next(iter(predictionio_tpu.__path__)) + "/..")
     script = tmp_path / "cooc_worker.py"
     script.write_text(
-        _COOC_WORKER.format(repo=repo, coord=f"127.0.0.1:{_free_port()}")
+        _COOC_WORKER.format(repo=_repo_root(), coord=f"127.0.0.1:{_free_port()}")
     )
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(pid)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for pid in (0, 1)
-    ]
-    outs = [p.communicate(timeout=240)[0] for p in procs]
-    for p, text in zip(procs, outs):
-        assert p.returncode == 0, text
-        assert "OK" in text
+    _run_workers(script)

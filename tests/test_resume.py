@@ -136,10 +136,10 @@ class TestResumeWorkflow:
             return real_fit(*args, **kwargs)
 
         monkeypatch.setattr(als_mod, "als_fit", spying_fit)
-        # the template module imported als_fit by name; patch there too
-        from predictionio_tpu.models.recommendation import engine as rec_engine
+        # the shared template helper imported als_fit by name; patch there too
+        from predictionio_tpu.models import _als_common
 
-        monkeypatch.setattr(rec_engine, "als_fit", spying_fit)
+        monkeypatch.setattr(_als_common, "als_fit", spying_fit)
 
         resumed = run_train(variant, WorkflowParams(resume=True))
         assert resumed.id == crashed.id
@@ -173,7 +173,7 @@ class TestResumeWorkflow:
         finally:
             crasher.restore()
 
-        from predictionio_tpu.models.recommendation import engine as rec_engine
+        from predictionio_tpu.models import _als_common
         from predictionio_tpu.parallel import als as als_mod
 
         starts = []
@@ -183,7 +183,7 @@ class TestResumeWorkflow:
             starts.append(kwargs.get("start_iteration", 0))
             return real_fit(*args, **kwargs)
 
-        monkeypatch.setattr(rec_engine, "als_fit", spying_fit)
+        monkeypatch.setattr(_als_common, "als_fit", spying_fit)
         fresh = run_train(variant)  # no resume flag
         assert fresh.status == STATUS_COMPLETED
         assert starts == [0]  # stale checkpoints wiped, not resumed
@@ -259,7 +259,7 @@ class TestResumeWorkflow:
             app_id=app_id,
         )
 
-        from predictionio_tpu.models.recommendation import engine as rec_engine
+        from predictionio_tpu.models import _als_common
         from predictionio_tpu.parallel import als as als_mod
 
         starts = []
@@ -269,7 +269,7 @@ class TestResumeWorkflow:
             starts.append(kwargs.get("start_iteration", 0))
             return real_fit(*args, **kwargs)
 
-        monkeypatch.setattr(rec_engine, "als_fit", spying_fit)
+        monkeypatch.setattr(_als_common, "als_fit", spying_fit)
         resumed = run_train(variant, WorkflowParams(resume=True))
         assert resumed.status == STATUS_COMPLETED
         assert starts == [0]  # fingerprint mismatch -> clean fresh start
