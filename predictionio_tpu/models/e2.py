@@ -12,6 +12,9 @@ component.
   smoothing (reference MarkovChain), trained as one one-hot matmul.
 - :func:`cross_validation_folds` -- generic k-fold splitter (reference
   e2.evaluation.CrossValidation).
+- :func:`kmeans` -- re-export of the mesh KMeans (``ops.kmeans``), the
+  MLlib KMeans counterpart some reference templates cluster with
+  (SURVEY.md section 2.8).
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ import numpy as np
 
 from predictionio_tpu.ops.classify import NaiveBayesModel, train_naive_bayes
 from predictionio_tpu.ops.features import BinaryVectorizer
+from predictionio_tpu.ops.kmeans import KMeansModel, kmeans_fit as kmeans  # noqa: F401
 
 
 @dataclass

@@ -1,0 +1,146 @@
+"""Lloyd's K-Means on the device mesh.
+
+Parity target: MLlib ``KMeans``, invoked by several reference engine
+templates (SURVEY.md §2.8 lists it among the MLlib algorithms the template
+zoo leans on). TPU-first shape:
+
+- the assignment step is the matmul identity ``|x - c|^2 = |x|^2 - 2 x.c +
+  |c|^2`` -- one ``[N, D] @ [D, K]`` product on the MXU, no pairwise loop;
+- the update step is a one-hot matmul ``onehot(assign)^T @ x`` -- also MXU;
+- with a mesh, rows shard over the ``data`` axis and GSPMD inserts the
+  psums for the ``[K, D]`` sums / ``[K]`` counts (the Spark-shuffle
+  aggregation of MLlib's per-partition accumulators, as collectives);
+- k-means++ seeding runs host-side on numpy (O(N*K) once, sequential by
+  nature), matching MLlib's ``k-means||`` role without the distributed
+  variant's extra passes.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+@functools.lru_cache(maxsize=32)
+def _build_step(mesh, k: int):
+    row = NamedSharding(mesh, PartitionSpec("data"))
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def step(x, w, centers):
+        # [N, K] squared distances via the matmul identity; padding rows
+        # (w == 0) still argmin somewhere, their contribution is zeroed
+        x2 = jnp.sum(x * x, axis=1, keepdims=True)
+        c2 = jnp.sum(centers * centers, axis=1)
+        d = x2 - 2.0 * (x @ centers.T) + c2[None]
+        assign = jnp.argmin(d, axis=1)
+        onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * w[:, None]
+        sums = onehot.T @ x                 # [K, D] -- psum over 'data'
+        counts = onehot.sum(axis=0)         # [K]
+        new_centers = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
+        )
+        cost = jnp.sum(jnp.min(d, axis=1) * w)
+        return new_centers, assign, cost
+
+    return jax.jit(
+        step,
+        in_shardings=(row, row, rep),
+        out_shardings=(rep, row, rep),
+    )
+
+
+def _kmeanspp_init(x: np.ndarray, k: int, rng: np.random.Generator) -> np.ndarray:
+    """Standard k-means++ seeding (host, numpy)."""
+    n = x.shape[0]
+    centers = np.empty((k, x.shape[1]), dtype=x.dtype)
+    centers[0] = x[rng.integers(n)]
+    d2 = np.sum((x - centers[0]) ** 2, axis=1)
+    for j in range(1, k):
+        total = float(d2.sum())
+        if total <= 0.0:
+            # every remaining point coincides with a chosen center (constant
+            # or heavily duplicated data): any pick is equally (un)good --
+            # rng.choice with an all-zero p would raise instead
+            centers[j] = x[rng.integers(n)]
+            continue
+        centers[j] = x[rng.choice(n, p=d2 / total)]
+        d2 = np.minimum(d2, np.sum((x - centers[j]) ** 2, axis=1))
+    return centers
+
+
+@dataclass
+class KMeansModel:
+    centers: np.ndarray       # [k, D]
+    cost: float               # final within-cluster sum of squares
+    iterations_run: int
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float32)
+        d = (
+            np.sum(x * x, axis=1, keepdims=True)
+            - 2.0 * (x @ self.centers.T)
+            + np.sum(self.centers * self.centers, axis=1)[None]
+        )
+        return d.argmin(axis=1)
+
+
+def kmeans_fit(
+    x: np.ndarray,
+    k: int,
+    iterations: int = 20,
+    tol: float = 1e-4,
+    seed: int = 0,
+    mesh=None,
+) -> KMeansModel:
+    """Fit K-Means with k-means++ init and Lloyd iterations on the mesh.
+
+    Rows pad to a lane-aligned multiple of the mesh's ``data`` axis with
+    zero weight, so every shard is equal-sized and padding never moves a
+    center. Stops early when the relative cost improvement drops below
+    ``tol`` (MLlib's epsilon semantics).
+    """
+    from predictionio_tpu.parallel.mesh import local_mesh, put_global
+
+    if k <= 0:
+        raise ValueError(f"k must be positive, got {k}")
+    x = np.asarray(x, dtype=np.float32)
+    if x.ndim != 2 or x.shape[0] < k:
+        raise ValueError(f"need a [N>=k, D] matrix, got shape {x.shape}")
+    mesh = mesh or local_mesh(1, 1)
+    shards = mesh.shape.get("data", 1)
+    n = x.shape[0]
+    padded = -(-n // (8 * shards)) * (8 * shards)
+    xp = np.pad(x, ((0, padded - n), (0, 0)))
+    w = np.zeros(padded, dtype=np.float32)
+    w[:n] = 1.0
+
+    rng = np.random.default_rng(seed)
+    centers = jnp.asarray(_kmeanspp_init(x, k, rng))
+    row = NamedSharding(mesh, PartitionSpec("data"))
+    xd = put_global(xp, row)
+    wd = put_global(w, row)
+    step = _build_step(mesh, k)
+
+    prev_cost = None
+    it = 0
+    for it in range(1, iterations + 1):
+        centers, _, cost_dev = step(xd, wd, centers)
+        # step() scores the INPUT centers (assignment happens before the
+        # update), so this cost lags the centers it returns by one update
+        cost = float(cost_dev)
+        # first iteration has no previous cost to compare against (inf
+        # would make the threshold inf and stop the loop immediately)
+        if prev_cost is not None and prev_cost - cost <= tol * abs(prev_cost):
+            break
+        prev_cost = cost
+    # one assignment-only pass so the reported cost matches the RETURNED
+    # centers, not the pre-update ones
+    _, _, final_cost = step(xd, wd, centers)
+    return KMeansModel(
+        centers=np.asarray(centers), cost=float(final_cost), iterations_run=it
+    )

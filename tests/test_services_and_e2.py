@@ -103,6 +103,78 @@ class TestE2:
             assert set(train) & set(test) == set()
             assert len(train) + len(test) == 10
 
+    def test_kmeans_recovers_blobs(self):
+        from predictionio_tpu.models.e2 import kmeans
+
+        rng = np.random.default_rng(4)
+        true = np.array([[0.0, 0.0], [10.0, 10.0], [-10.0, 8.0]])
+        x = np.concatenate(
+            [rng.normal(c, 0.4, size=(60, 2)) for c in true]
+        ).astype(np.float32)
+        model = kmeans(x, k=3, iterations=30, seed=1)
+        # each true center has a learned center within the blob radius
+        dists = np.linalg.norm(model.centers[:, None] - true[None], axis=2)
+        assert (dists.min(axis=0) < 0.5).all(), model.centers
+        # labels partition the data into the three 60-point blobs
+        labels = model.predict(x)
+        sizes = sorted(np.bincount(labels, minlength=3).tolist())
+        assert sizes == [60, 60, 60]
+        assert model.cost < 120  # ~180 pts * var 0.16 * 2 dims
+
+    def test_kmeans_sharded_matches_single_device(self):
+        from predictionio_tpu.models.e2 import kmeans
+        from predictionio_tpu.parallel.mesh import local_mesh
+
+        rng = np.random.default_rng(9)
+        # 77 rows: does not divide the 8-way mesh -> exercises zero-weight
+        # row padding
+        x = rng.normal(size=(77, 5)).astype(np.float32)
+        a = kmeans(x, k=4, iterations=10, seed=2)
+        b = kmeans(x, k=4, iterations=10, seed=2, mesh=local_mesh(8, 1))
+        np.testing.assert_allclose(a.centers, b.centers, atol=1e-4)
+        assert abs(a.cost - b.cost) < 1e-2
+
+    def test_kmeans_iterates_beyond_one_step(self):
+        """Regression: an inf initial prev-cost made the tol check stop
+        every fit after exactly one Lloyd iteration."""
+        from predictionio_tpu.models.e2 import kmeans
+
+        rng = np.random.default_rng(12)
+        x = rng.normal(size=(300, 6)).astype(np.float32)  # no blob structure
+        one = kmeans(x, k=6, iterations=1, seed=3)
+        many = kmeans(x, k=6, iterations=25, seed=3)
+        assert many.iterations_run > 1
+        assert many.cost < one.cost  # extra Lloyd steps must keep improving
+
+    def test_kmeans_cost_matches_returned_centers(self):
+        """model.cost must be the WCSS of model.centers (not one Lloyd
+        update stale), so a caller can reproduce it from predict()."""
+        from predictionio_tpu.models.e2 import kmeans
+
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(120, 4)).astype(np.float32)
+        m = kmeans(x, k=3, iterations=2, seed=0)
+        labels = m.predict(x)
+        wcss = float(np.sum((x - m.centers[labels]) ** 2))
+        np.testing.assert_allclose(m.cost, wcss, rtol=1e-4)
+
+    def test_kmeans_degenerate_duplicate_data(self):
+        """All-identical rows: k-means++ must not crash on an all-zero
+        distance distribution; the fit degenerates gracefully."""
+        from predictionio_tpu.models.e2 import kmeans
+
+        m = kmeans(np.ones((8, 2), np.float32), k=2, iterations=3)
+        assert m.cost == 0.0
+        np.testing.assert_allclose(m.centers, 1.0)
+
+    def test_kmeans_input_validation(self):
+        from predictionio_tpu.models.e2 import kmeans
+
+        with np.testing.assert_raises(ValueError):
+            kmeans(np.zeros((3, 2), np.float32), k=5)
+        with np.testing.assert_raises(ValueError):
+            kmeans(np.zeros((8, 2), np.float32), k=0)
+
 
 class TestStageTimings:
     def test_train_records_timings(self, storage_env, tmp_path):
