@@ -6,13 +6,14 @@ Query contract matches the recommendation template:
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import threading
+from dataclasses import dataclass, field
 
 import numpy as np
 
 from predictionio_tpu.controller import Engine, FirstServing, TPUAlgorithm
 from predictionio_tpu.models.ncf.kernel import (
-    ncf_score_all_items,
+    make_all_items_scorer,
     reference_score_all_items,
 )
 from predictionio_tpu.models.ncf.model import (
@@ -25,6 +26,10 @@ from predictionio_tpu.models.recommendation.engine import (
     RecommendationDataSource,
 )
 from predictionio_tpu.controller.base import Preparator
+
+
+#: guards first-query scorer construction across serving threads
+_SCORER_BUILD_LOCK = threading.Lock()
 
 
 class NCFPreparator(Preparator):
@@ -42,6 +47,35 @@ class NCFModel:
     item_index: dict[str, int]
     seen: dict[int, set[int]]
     use_pallas: bool
+    #: lazily-built device-resident scorer (tables uploaded once); holds
+    #: device buffers and a jit closure, so it must never be pickled into
+    #: the model blob -- __getstate__ strips it and the first query after
+    #: a deploy rebuilds it
+    _scorer: object = field(default=None, init=False, repr=False, compare=False)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_scorer"] = None
+        return state
+
+    def scorer(self):
+        # the query server is a ThreadingHTTPServer: concurrent first
+        # queries must not each upload the tables and compile the kernel
+        # (double-checked under a module lock; a per-model lock would not
+        # survive pickling)
+        if self._scorer is None:
+            with _SCORER_BUILD_LOCK:
+                if self._scorer is None:
+                    if self.use_pallas:
+                        self._scorer = make_all_items_scorer(
+                            self.params, len(self.item_ids), interpret=False
+                        )
+                    else:
+                        n = len(self.item_ids)
+                        self._scorer = lambda u: reference_score_all_items(
+                            self.params, u, n
+                        )
+        return self._scorer
 
 
 class NCFAlgorithm(TPUAlgorithm):
@@ -97,13 +131,7 @@ class NCFAlgorithm(TPUAlgorithm):
         user_idx = model.user_index.get(str(query.get("user")))
         if user_idx is None:
             return {"itemScores": []}
-        n_items = len(model.item_ids)
-        if model.use_pallas:
-            scores = ncf_score_all_items(
-                model.params, user_idx, n_items, interpret=False
-            )
-        else:
-            scores = reference_score_all_items(model.params, user_idx, n_items)
+        scores = model.scorer()(user_idx)
         exclude = {
             model.item_index[str(b)]
             for b in (query.get("blackList") or [])

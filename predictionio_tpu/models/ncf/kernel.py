@@ -20,7 +20,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-TILE_I = 512
+# 1024 = XLA's tile for 1-D f32 arrays (8 sublanes x 128 lanes): the
+# kernel's output block must match it exactly -- real TPU lowering rejects
+# a T(512) Mosaic layout against XLA's T(1024) (interpret mode cannot see
+# the mismatch), and 2-D (1, TILE) output blocks fail the (8, 128)
+# divisibility rule
+TILE_I = 1024
 
 
 def _ncf_score_kernel(
@@ -61,14 +66,23 @@ def _mlp_depth(params) -> int:
     return len([k for k in params if k.startswith("mlp_") and k[4:].isdigit()])
 
 
-def ncf_score_all_items(params, user_index: int, num_items: int, interpret: bool):
-    """Score all items for one user via the fused kernel. Host-callable.
+def make_all_items_scorer(params, num_items: int, interpret: bool):
+    """Build a host-callable ``score(user_index) -> np.ndarray[num_items]``.
+
+    The item tables and MLP weights upload to the device ONCE at build
+    time, and each call is a single jitted dispatch (the user-row gather
+    runs on device) plus one result fetch. The per-call construction this
+    replaces re-uploaded ~13 operands and re-dispatched eagerly -- on the
+    remote-tunnel TPU backend that cost ~860 ms/query in round-trips; the
+    cached scorer measures ~2 orders of magnitude faster.
 
     The kernel is specialized to the default 2-hidden-layer tower; other
     depths fall back to the (XLA-fused anyway) reference head.
     """
     if _mlp_depth(params) != 2:
-        return reference_score_all_items(params, user_index, num_items)
+        return lambda user_index: reference_score_all_items(
+            params, user_index, num_items
+        )
     e = params["gmf_user"]["embedding"].shape[1]
     h0 = params["mlp_0"]["kernel"].shape[1]
     h1 = params["mlp_1"]["kernel"].shape[1]
@@ -83,25 +97,27 @@ def ncf_score_all_items(params, user_index: int, num_items: int, interpret: bool
 
     w0 = np.asarray(params["mlp_0"]["kernel"], np.float32)   # [2E, H0]
     out_w = np.asarray(params["out"]["kernel"], np.float32)  # [E+H1, 1]
-    args = (
-        jnp.asarray(gmf_items),
-        jnp.asarray(mlp_items),
-        jnp.asarray(params["gmf_user"]["embedding"][user_index], np.float32)[None, :],
-        jnp.asarray(params["mlp_user"]["embedding"][user_index], np.float32)[None, :],
-        jnp.asarray(w0[:e]),
-        jnp.asarray(w0[e:]),
-        jnp.asarray(params["mlp_0"]["bias"], np.float32)[None, :],
-        jnp.asarray(params["mlp_1"]["kernel"], np.float32),
-        jnp.asarray(params["mlp_1"]["bias"], np.float32)[None, :],
-        jnp.asarray(out_w[:e, 0])[None, :],
-        jnp.asarray(out_w[e:, 0])[None, :],
-        jnp.asarray(params["out"]["bias"], np.float32).reshape(1, 1),
+    device = jax.devices()[0] if not interpret else None
+    put = (lambda a: jax.device_put(jnp.asarray(a), device)) if device else jnp.asarray
+    gmf_items_d = put(gmf_items)
+    mlp_items_d = put(mlp_items)
+    gmf_user_tab = put(np.asarray(params["gmf_user"]["embedding"], np.float32))
+    mlp_user_tab = put(np.asarray(params["mlp_user"]["embedding"], np.float32))
+    weights = (
+        put(w0[:e]),
+        put(w0[e:]),
+        put(np.asarray(params["mlp_0"]["bias"], np.float32)[None, :]),
+        put(np.asarray(params["mlp_1"]["kernel"], np.float32)),
+        put(np.asarray(params["mlp_1"]["bias"], np.float32)[None, :]),
+        put(np.asarray(out_w[:e, 0])[None, :]),
+        put(np.asarray(out_w[e:, 0])[None, :]),
+        put(np.asarray(params["out"]["bias"], np.float32).reshape(1, 1)),
     )
 
     grid = padded // TILE_I
     tile_spec = lambda: pl.BlockSpec((TILE_I, e), lambda i: (i, 0))
     rep = lambda r, c: pl.BlockSpec((r, c), lambda i: (0, 0))
-    scores = pl.pallas_call(
+    call = pl.pallas_call(
         _ncf_score_kernel,
         out_shape=jax.ShapeDtypeStruct((padded,), jnp.float32),
         grid=(grid,),
@@ -121,8 +137,21 @@ def ncf_score_all_items(params, user_index: int, num_items: int, interpret: bool
         ],
         out_specs=pl.BlockSpec((TILE_I,), lambda i: (i,)),
         interpret=interpret,
-    )(*args)
-    return np.asarray(scores)[:num_items]
+    )
+
+    @jax.jit
+    def score(user_idx):
+        gmf_u = jax.lax.dynamic_slice_in_dim(gmf_user_tab, user_idx, 1)
+        mlp_u = jax.lax.dynamic_slice_in_dim(mlp_user_tab, user_idx, 1)
+        return call(gmf_items_d, mlp_items_d, gmf_u, mlp_u, *weights)
+
+    return lambda user_index: np.asarray(score(np.int32(user_index)))[:num_items]
+
+
+def ncf_score_all_items(params, user_index: int, num_items: int, interpret: bool):
+    """One-shot convenience around :func:`make_all_items_scorer` (tests,
+    oracles). Serving paths should build the scorer once and reuse it."""
+    return make_all_items_scorer(params, num_items, interpret)(user_index)
 
 
 def reference_score_all_items(params, user_index: int, num_items: int) -> np.ndarray:
