@@ -18,6 +18,21 @@ Shapes follow plain_attention: q, k, v [B, T, H, D]; optional key-validity
 ``mask`` [B, T]; causal masking over absolute positions. On CPU test
 backends the kernels run in interpret mode (tests pin fwd+grad against
 plain_attention).
+
+Real-hardware layout constraints (learned the hard way -- interpret mode
+checks none of this):
+
+- Blocks must keep their last two dims (8, 128)-divisible or equal to the
+  array dims. The public [B, T, H, D] layout blocks as (1, BQ, 1, D) with
+  a second-minor 1 != H, so tensors transpose to [B, H, T, D] at the
+  pallas boundary and blocks become (1, 1, BQ, D).
+- Row operands (mask, lse, delta) carry a singleton middle axis --
+  [B, 1, T] / [B*H, 1, T] -- so their (1, T)-shaped blocks match the
+  array's own last-two dims.
+- Mosaic cannot do dynamic SUBLANE (row) indexing inside a kernel
+  ("dynamic load with unaligned indices"): all row selection lives in the
+  BlockSpec index maps (per-program DMA), and in-kernel dynamic slices are
+  lane/sublane slices at 128-multiple offsets only.
 """
 
 from __future__ import annotations
@@ -40,25 +55,25 @@ def _pos(n: int, offset):
 
 
 def _fwd_kernel(
-    q_ref,      # [1, BQ, 1, D]
-    k_ref,      # [1, T, 1, D]
-    v_ref,      # [1, T, 1, D]
-    mask_ref,   # [1, T]
-    out_ref,    # [1, BQ, 1, D]
-    lse_ref,    # [1, BQ]
+    q_ref,      # [1, 1, BQ, D]
+    k_ref,      # [1, 1, T, D]
+    v_ref,      # [1, 1, T, D]
+    mask_ref,   # [1, 1, T]
+    out_ref,    # [1, 1, BQ, D]
+    lse_ref,    # [1, 1, BQ]
     *, causal: bool, sm_scale: float, block_k: int,
 ):
     qi = pl.program_id(2)
-    bq, d = q_ref.shape[1], q_ref.shape[3]
-    t = k_ref.shape[1]
-    q = q_ref[0, :, 0, :].astype(jnp.float32)
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    t = k_ref.shape[2]
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
     q_pos = _pos(bq, qi * bq)
 
     def body(kb, carry):
         acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
-        msk = mask_ref[0, pl.ds(kb * block_k, block_k)]
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        msk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -83,8 +98,8 @@ def _fwd_kernel(
     l0 = jnp.zeros((bq,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, t // block_k, body, (acc0, m0, l0))
 
-    out_ref[0, :, 0, :] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(out_ref.dtype)
-    lse_ref[0, :] = m + jnp.log(jnp.maximum(l, 1e-20))
+    out_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(out_ref.dtype)
+    lse_ref[0, 0, :] = m + jnp.log(jnp.maximum(l, 1e-20))
 
 
 def _dq_kernel(
@@ -94,18 +109,18 @@ def _dq_kernel(
 ):
     """dQ for one query block: dq = sum_kb (P o (dP - delta)) K * scale."""
     qi = pl.program_id(2)
-    bq, d = q_ref.shape[1], q_ref.shape[3]
-    t = k_ref.shape[1]
-    q = q_ref[0, :, 0, :].astype(jnp.float32)
-    do = do_ref[0, :, 0, :].astype(jnp.float32)
-    lse = lse_ref[0, :]
-    delta = delta_ref[0, :]
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    t = k_ref.shape[2]
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :]
+    delta = delta_ref[0, 0, :]
     q_pos = _pos(bq, qi * bq)
 
     def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
-        msk = mask_ref[0, pl.ds(kb * block_k, block_k)]
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        msk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -126,7 +141,7 @@ def _dq_kernel(
         )
 
     dq = jax.lax.fori_loop(0, t // block_k, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, :, 0, :] = dq.astype(dq_ref.dtype)
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
@@ -136,19 +151,19 @@ def _dkv_kernel(
 ):
     """dK/dV for one key block: loop over query blocks."""
     ki = pl.program_id(2)
-    bk, d = k_ref.shape[1], k_ref.shape[3]
-    t = q_ref.shape[1]
-    k_blk = k_ref[0, :, 0, :].astype(jnp.float32)
-    v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
-    msk = mask_ref[0, pl.ds(ki * bk, bk)]
+    bk, d = k_ref.shape[2], k_ref.shape[3]
+    t = q_ref.shape[2]
+    k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+    v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+    msk = mask_ref[0, 0, pl.ds(ki * bk, bk)]
     k_pos = _pos(bk, ki * bk)
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), 0, :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), 0, :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -176,8 +191,8 @@ def _dkv_kernel(
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(0, t // block_q, body, (dk0, dv0))
-    dk_ref[0, :, 0, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, :, 0, :] = dv.astype(dv_ref.dtype)
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
 
 
 def _pad_t(x, t_padded):
@@ -190,12 +205,23 @@ def _pad_t(x, t_padded):
 
 
 def _specs(b_dim, t, h_dim, d, bq):
-    """(index-mapped) block specs shared by the three kernels."""
-    q_spec = pl.BlockSpec((1, bq, 1, d), lambda b, h, i: (b, i, h, 0))
-    kv_spec = pl.BlockSpec((1, t, 1, d), lambda b, h, i: (b, 0, h, 0))
-    mask_spec = pl.BlockSpec((1, t), lambda b, h, i: (b, 0))
-    row_spec = pl.BlockSpec((1, bq), lambda b, h, i: ((b * h_dim + h), i))
-    return q_spec, kv_spec, mask_spec, row_spec
+    """(index-mapped) block specs shared by the three kernels.
+
+    Device tensors are [B, H, T, D]; row operands are [B, 1, T] (mask) and
+    [B*H, 1, T] (lse/delta), with all row selection in the index maps.
+    """
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, i: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, t, d), lambda b, h, i: (b, h, 0, 0))
+    mask_spec = pl.BlockSpec((1, 1, t), lambda b, h, i: (b, 0, 0))
+    #: one query block of this (b, h)'s lse/delta row
+    row_blk_spec = pl.BlockSpec((1, 1, bq), lambda b, h, i: (b * h_dim + h, 0, i))
+    #: the full lse/delta row (dkv loops over all query blocks)
+    row_full_spec = pl.BlockSpec((1, 1, t), lambda b, h, i: (b * h_dim + h, 0, 0))
+    return q_spec, kv_spec, mask_spec, row_blk_spec, row_full_spec
+
+
+def _to_bhtd(x):
+    return jnp.transpose(x, (0, 2, 1, 3))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -219,24 +245,24 @@ def _flash_forward(q, k, v, mask, causal, sm_scale, interpret):
     if mask is None:
         mask = jnp.ones((b, t), bool)
     qp, kp, vp = (_pad_t(x, t_padded) for x in (q, k, v))
-    maskp = _pad_t(mask.astype(bool), t_padded)  # pad -> False (invalid)
+    maskp = _pad_t(mask.astype(bool), t_padded)[:, None, :]  # pad -> invalid
 
     nq = t_padded // bq
-    q_spec, kv_spec, mask_spec, row_spec = _specs(b, t_padded, h, d, bq)
+    q_spec, kv_spec, mask_spec, row_blk_spec, _ = _specs(b, t_padded, h, d, bq)
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, sm_scale=scale, block_k=bk
         ),
         grid=(b, h, nq),
         in_specs=[q_spec, kv_spec, kv_spec, mask_spec],
-        out_specs=[q_spec, row_spec],
+        out_specs=[q_spec, row_blk_spec],
         out_shape=[
-            _struct(qp.shape, q.dtype, q),
-            _struct((b * h, t_padded), jnp.float32, q),
+            _struct((b, h, t_padded, d), q.dtype, q),
+            _struct((b * h, 1, t_padded), jnp.float32, q),
         ],
         interpret=interpret,
-    )(qp, kp, vp, maskp)
-    return out[:, :t], lse
+    )(_to_bhtd(qp), _to_bhtd(kp), _to_bhtd(vp), maskp)
+    return _to_bhtd(out)[:, :t], lse
 
 
 def _struct(shape, dtype, like):
@@ -270,42 +296,55 @@ def _flash_bwd(causal, sm_scale, interpret, res, g):
 
     # delta[b,h,i] = rowsum(dO o O): the softmax-jacobian correction term
     delta = jnp.einsum("bthd,bthd->bht", g.astype(jnp.float32),
-                       out.astype(jnp.float32)).reshape(b * h, t)
+                       out.astype(jnp.float32)).reshape(b * h, 1, t)
 
     qp, kp, vp, gp = (_pad_t(x, t_padded) for x in (q, k, v, g))
-    maskp = _pad_t(mask.astype(bool), t_padded)
-    lsep = jnp.pad(lse, ((0, 0), (0, t_padded - t)))
-    deltap = jnp.pad(delta, ((0, 0), (0, t_padded - t)))
+    maskp = _pad_t(mask.astype(bool), t_padded)[:, None, :]
+    lsep = lse  # already t_padded long: it never left the padded domain
+    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, t_padded - t)))
 
     nq = t_padded // bq
     nk = t_padded // bk
-    q_spec, kv_spec, mask_spec, row_spec = _specs(b, t_padded, h, d, bq)
-    full_row = pl.BlockSpec((1, t_padded), lambda b_, h_, i: ((b_ * h + h_), 0))
-    full_q = pl.BlockSpec((1, t_padded, 1, d), lambda b_, h_, i: (b_, 0, h_, 0))
+    q_spec, kv_spec, mask_spec, row_blk_spec, row_full_spec = _specs(
+        b, t_padded, h, d, bq
+    )
+    full_q = pl.BlockSpec((1, 1, t_padded, d), lambda b_, h_, i: (b_, h_, 0, 0))
 
+    qt, kt, vt, gt = (_to_bhtd(x) for x in (qp, kp, vp, gp))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, sm_scale=scale, block_k=bk),
         grid=(b, h, nq),
-        in_specs=[q_spec, kv_spec, kv_spec, mask_spec, q_spec, row_spec, row_spec],
+        in_specs=[
+            q_spec, kv_spec, kv_spec, mask_spec, q_spec,
+            row_blk_spec, row_blk_spec,
+        ],
         out_specs=q_spec,
-        out_shape=_struct(qp.shape, q.dtype, q),
+        out_shape=_struct((b, h, t_padded, d), q.dtype, q),
         interpret=interpret,
-    )(qp, kp, vp, maskp, gp, lsep, deltap)
+    )(qt, kt, vt, maskp, gt, lsep, deltap)
 
-    k_spec = pl.BlockSpec((1, bk, 1, d), lambda b_, h_, i: (b_, i, h_, 0))
+    k_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, sm_scale=scale, block_q=bq),
         grid=(b, h, nk),
-        in_specs=[full_q, k_spec, k_spec, mask_spec, full_q, full_row, full_row],
+        in_specs=[
+            full_q, k_spec, k_spec, mask_spec, full_q,
+            row_full_spec, row_full_spec,
+        ],
         out_specs=[k_spec, k_spec],
         out_shape=[
-            _struct(kp.shape, k.dtype, k),
-            _struct(vp.shape, v.dtype, v),
+            _struct((b, h, t_padded, d), k.dtype, k),
+            _struct((b, h, t_padded, d), v.dtype, v),
         ],
         interpret=interpret,
-    )(qp, kp, vp, maskp, gp, lsep, deltap)
+    )(qt, kt, vt, maskp, gt, lsep, deltap)
 
-    return dq[:, :t], dk[:, :t], dv[:, :t], mask_grad
+    return (
+        _to_bhtd(dq)[:, :t],
+        _to_bhtd(dk)[:, :t],
+        _to_bhtd(dv)[:, :t],
+        mask_grad,
+    )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -22,7 +22,7 @@ def tiny_params():
     import jax
     import jax.numpy as jnp
 
-    config = NCFConfig(num_users=10, num_items=700, embed_dim=8, hidden=(16, 8))
+    config = NCFConfig(num_users=10, num_items=1500, embed_dim=8, hidden=(16, 8))
     model = NeuMF(config)
     params = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32)
@@ -33,10 +33,12 @@ def tiny_params():
 class TestPallasKernel:
     def test_matches_reference_including_ragged_tail(self, tiny_params):
         config, params = tiny_params
-        # 700 items: exercises the padded tile tail (512-aligned -> 1024)
+        # 1500 items: >1 grid step at TILE_I=1024 (a wrong tile index
+        # map would score the tail with tile-0 embeddings) AND a ragged
+        # padded tail (1500 -> 2048)
         got = ncf_score_all_items(params, 3, config.num_items, interpret=True)
         want = reference_score_all_items(params, 3, config.num_items)
-        assert got.shape == (700,)
+        assert got.shape == (1500,)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
     def test_flax_apply_agrees_with_reference_head(self, tiny_params):
