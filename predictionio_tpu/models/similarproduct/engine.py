@@ -22,9 +22,8 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.base import SanityCheck
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.ops.cooccurrence import (
-    cooccurrence,
-    llr_scores,
-    top_k_sparsify,
+    cooccurrence_indicators,
+    distinct_user_counts,
 )
 from predictionio_tpu.ops.ragged import pack_padded_csr
 
@@ -120,17 +119,24 @@ class CooccurrenceAlgorithm(TPUAlgorithm):
             times=data.times,
             max_len=self.params.get_or("maxEventsPerUser", None),
         )
-        cooc = cooccurrence(
+        # fused on-device cooc -> (LLR) -> top-k; the self-cooccurrence
+        # diagonal (= per-item distinct-user counts) comes from the O(nnz)
+        # host pass so the [items, items] matrix never leaves the device
+        llr_kwargs = {}
+        if self.params.get_or("llr", True):
+            totals = distinct_user_counts(csr)
+            llr_kwargs = dict(
+                llr_row_totals=totals,
+                llr_col_totals=totals,
+                total=len(data.user_ids),
+            )
+        idx, vals = cooccurrence_indicators(
             csr,
+            top_k=self.params.get_or("topK", 50),
             chunk=self.params.get_or("chunk", 4096),
             mesh=self.mesh_or_none(ctx),  # user rows dp-sharded, psum acc
+            **llr_kwargs,
         )
-        if self.params.get_or("llr", True):
-            totals = np.diag(cooc).copy()
-            matrix = llr_scores(cooc, totals, totals, total=len(data.user_ids))
-        else:
-            matrix = cooc
-        idx, vals = top_k_sparsify(matrix, self.params.get_or("topK", 50))
         history: dict[str, list[int]] = {}
         for u, i in zip(data.users, data.items):
             history.setdefault(data.user_ids[int(u)], []).append(int(i))

@@ -22,11 +22,7 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.controller.base import SanityCheck
 from predictionio_tpu.data.store import PEventStore
-from predictionio_tpu.ops.cooccurrence import (
-    cooccurrence,
-    llr_scores,
-    top_k_sparsify,
-)
+from predictionio_tpu.ops.cooccurrence import cooccurrence_indicators
 from predictionio_tpu.ops.ragged import pack_padded_csr
 
 
@@ -179,19 +175,24 @@ class URAlgorithm(TPUAlgorithm):
         for name in data.event_names:
             if data.per_event[name][0].size == 0:
                 continue
-            csr = primary_csr if name == data.event_names[0] else to_csr(
-                data.per_event[name]
-            )
-            cooc = cooccurrence(primary_csr, csr, chunk=chunk, mesh=mesh)
+            is_primary = name == data.event_names[0]
+            csr = primary_csr if is_primary else to_csr(data.per_event[name])
             col_counts = (
-                primary_counts
-                if name == data.event_names[0]
-                else distinct_user_counts(csr)
+                primary_counts if is_primary else distinct_user_counts(csr)
             )
-            llr = llr_scores(cooc, primary_counts, col_counts, total=n_users)
+            # fused on-device cooc -> LLR -> top-k: only the [items, topK]
+            # indicators leave the device, never the [items, items] matrix
             indicators[name] = _invert_indicators(
-                *top_k_sparsify(
-                    llr, top_k, drop_diagonal=(name == data.event_names[0])
+                *cooccurrence_indicators(
+                    primary_csr,
+                    None if is_primary else csr,
+                    top_k=top_k,
+                    llr_row_totals=primary_counts,
+                    llr_col_totals=col_counts,
+                    total=n_users,
+                    drop_diagonal=is_primary,
+                    chunk=chunk,
+                    mesh=mesh,
                 )
             )
         history: dict[str, dict[str, list[int]]] = {}

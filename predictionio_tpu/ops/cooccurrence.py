@@ -7,9 +7,13 @@ section 2.5 #37, BASELINE.json configs #3/#4).
 
 Design: cooccurrence is a matmul. With the user-history one-hot matrix
 ``A [users, items]``, the cooccurrence of primary events with event-type-t
-events is ``A_primary^T @ A_t`` -- the MXU's favorite shape. Users stream
-through in chunks (host builds each dense chunk from the padded CSR); the
-``[items, items]`` accumulator lives on device. LLR is then elementwise.
+events is ``A_primary^T @ A_t`` -- the MXU's favorite shape. Only the
+compact padded-CSR ``(indices, mask)`` ever leaves the host; the dense
+one-hot chunks are scattered ON DEVICE inside a ``lax.scan`` (an earlier
+host-built-chunk version shipped the dense [chunk, items] f32 blocks over
+the interconnect -- ~4 GB for 2M events on a remote-tunnel backend, ~40x
+the CSR's footprint). The ``[items, items]`` accumulator lives on device;
+LLR is then elementwise.
 """
 
 from __future__ import annotations
@@ -34,21 +38,20 @@ def _dense_onehot(indices, mask, num_cols: int):
     return jnp.minimum(out[:, :num_cols], 1.0)
 
 
-@functools.partial(jax.jit, static_argnames=("num_cols",), donate_argnums=(3,))
-def _accumulate_chunk(indices, mask, other_onehot, acc, *, num_cols):
-    """acc += onehot(indices)^T @ other_onehot for one user chunk."""
-    return acc + _dense_onehot(indices, mask, num_cols).T @ other_onehot
+def _normalize(primary: PaddedCSR, other: PaddedCSR | None, mesh):
+    """Shared preamble of both entry points: resolve self-cooccurrence,
+    validate the shared user universe, default to a 1-device local mesh
+    (same on-device path, degenerate psum)."""
+    other = other if other is not None else primary
+    if primary.num_rows != other.num_rows:
+        raise ValueError(
+            f"CSRs must share the user universe: {primary.num_rows} vs {other.num_rows}"
+        )
+    if mesh is None or "data" not in mesh.axis_names:
+        from predictionio_tpu.parallel.mesh import local_mesh
 
-
-def _onehot_chunk(csr: PaddedCSR, start: int, end: int) -> np.ndarray:
-    chunk = end - start
-    out = np.zeros((chunk, csr.num_cols), dtype=np.float32)
-    idx = csr.indices[start:end]
-    msk = csr.mask[start:end] > 0
-    rows = np.repeat(np.arange(chunk), idx.shape[1])
-    valid = msk.reshape(-1) & (idx.reshape(-1) < csr.num_cols)
-    out[rows[valid], idx.reshape(-1)[valid]] = 1.0
-    return out
+        mesh = local_mesh(1, 1)
+    return other, mesh
 
 
 def cooccurrence(
@@ -60,34 +63,16 @@ def cooccurrence(
     """``A_primary^T @ A_other`` over shared user rows -> [items_p, items_o].
 
     ``other=None`` means self-cooccurrence. Both CSRs must be row-indexed by
-    the same user universe (same num_rows). With ``mesh``, user rows shard
-    over the ``data`` axis: each device accumulates its local users'
-    contribution (scanning fixed-size chunks so the dense one-hot buffers
-    stay bounded) and one final ``psum`` combines the per-device
-    ``[items_p, items_o]`` partials over ICI -- the Spark-shuffle
-    aggregation of the reference's cooccurrence jobs as a single collective.
+    the same user universe (same num_rows). User rows shard over the mesh's
+    ``data`` axis (a 1-device local mesh when none is given): each device
+    scatters its local users' one-hot chunks on device and accumulates
+    their contribution (fixed-size chunks keep the dense buffers bounded),
+    and one final ``psum`` combines the per-device ``[items_p, items_o]``
+    partials over ICI -- the Spark-shuffle aggregation of the reference's
+    cooccurrence jobs as a single collective.
     """
-    other = other if other is not None else primary
-    if primary.num_rows != other.num_rows:
-        raise ValueError(
-            f"CSRs must share the user universe: {primary.num_rows} vs {other.num_rows}"
-        )
-    if mesh is not None and "data" not in mesh.axis_names:
-        mesh = None  # custom-axis mesh: run the host-streamed path
-    if mesh is not None and mesh.shape["data"] > 1:
-        return _cooccurrence_mesh(primary, other, chunk, mesh)
-    n_users = primary.num_rows
-    acc = jnp.zeros((primary.num_cols, other.num_cols), dtype=jnp.float32)
-    for start in range(0, n_users, chunk):
-        end = min(start + chunk, n_users)
-        acc = _accumulate_chunk(
-            jnp.asarray(primary.indices[start:end]),
-            jnp.asarray(primary.mask[start:end]),
-            jnp.asarray(_onehot_chunk(other, start, end)),
-            acc,
-            num_cols=primary.num_cols,
-        )
-    return np.asarray(acc)
+    other, mesh = _normalize(primary, other, mesh)
+    return _cooccurrence_mesh(primary, other, chunk, mesh)
 
 
 def _pad_rows_sentinel(csr: PaddedCSR, rows: int) -> tuple[np.ndarray, np.ndarray]:
@@ -99,32 +84,32 @@ def _pad_rows_sentinel(csr: PaddedCSR, rows: int) -> tuple[np.ndarray, np.ndarra
     return indices, mask
 
 
-def _cooccurrence_mesh(
-    primary: PaddedCSR, other: PaddedCSR, chunk: int, mesh
-) -> np.ndarray:
-    from jax.sharding import NamedSharding, PartitionSpec
+@functools.lru_cache(maxsize=64)
+def _build_cooc_fn(
+    mesh,
+    chunk: int,
+    num_p: int,
+    num_o: int,
+    len_p: int,
+    len_o: int,
+    top_k: int,
+    llr: bool,
+    drop_diagonal: bool,
+    total: float,
+):
+    """The jitted sharded cooccurrence program, cached by every static it
+    closes over (a fresh closure per call would retrace + recompile each
+    of URAlgorithm's per-event-type calls and every re-train). ``top_k ==
+    0`` returns the raw replicated accumulator; otherwise the (optionally
+    LLR-weighted) per-row top-k indicators, computed ON DEVICE so the
+    [items, items] matrix never crosses the host link. The LLR totals are
+    runtime ARGUMENTS (replicated), not baked constants, so one compiled
+    program serves every event type of the same shape.
+    """
+    from jax.sharding import PartitionSpec
 
-    data_size = int(mesh.shape["data"])
-    # base row math on the PHYSICAL (row_multiple-padded) CSR rows, not
-    # num_rows: pack_padded_csr rounds rows up, and a target below the
-    # physical count would make _pad_rows_sentinel's pad width negative
-    phys_rows = max(primary.indices.shape[0], other.indices.shape[0])
-    per_device = -(-phys_rows // data_size)
-    chunk = max(1, min(chunk, per_device))
-    # every device scans the same number of fixed-size chunks: pad the user
-    # universe so rows = data * chunks_per_device * chunk
-    chunks_per_device = -(-per_device // chunk)
-    rows = data_size * chunks_per_device * chunk
-    idx_p, msk_p = _pad_rows_sentinel(primary, rows)
-    if other is primary:  # self-cooccurrence: don't build/ship a second copy
-        idx_o, msk_o = idx_p, msk_p
-    else:
-        idx_o, msk_o = _pad_rows_sentinel(other, rows)
-    num_p, num_o = primary.num_cols, other.num_cols
-
-    def local(idx_p, msk_p, idx_o, msk_o):
-        local_rows = idx_p.shape[0]
-        n_chunks = local_rows // chunk
+    def local(idx_p, msk_p, idx_o, msk_o, row_t, col_t):
+        n_chunks = idx_p.shape[0] // chunk
 
         def body(acc, args):
             i_p, m_p, i_o, m_o = args
@@ -146,23 +131,86 @@ def _cooccurrence_mesh(
         acc, _ = jax.lax.scan(
             body, acc0, (split(idx_p), split(msk_p), split(idx_o), split(msk_o))
         )
-        return jax.lax.psum(acc, "data")
+        acc = jax.lax.psum(acc, "data")
+        if top_k == 0:
+            return acc
+        m = _llr_math(acc, row_t, col_t, total) if llr else acc
+        if drop_diagonal:
+            m = jnp.where(jnp.eye(num_p, dtype=bool), -jnp.inf, m)
+        vals, idx = jax.lax.top_k(m, top_k)
+        return idx.astype(jnp.int32), jnp.where(jnp.isfinite(vals), vals, 0.0)
 
     row = PartitionSpec("data")
     rep = PartitionSpec()
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(row, row, row, row),
-            out_specs=rep,
+            in_specs=(row, row, row, row, rep, rep),
+            out_specs=rep if top_k == 0 else (rep, rep),
         )
+    )
+
+
+def _run_cooc(
+    primary: PaddedCSR,
+    other: PaddedCSR,
+    chunk: int,
+    mesh,
+    *,
+    top_k: int = 0,
+    llr: bool = False,
+    drop_diagonal: bool = False,
+    total: float = 0.0,
+    row_totals=None,
+    col_totals=None,
+):
+    """Pad, upload (once per distinct CSR), run the cached program, fetch."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    data_size = int(mesh.shape["data"])
+    # base row math on the PHYSICAL (row_multiple-padded) CSR rows, not
+    # num_rows: pack_padded_csr rounds rows up, and a target below the
+    # physical count would make _pad_rows_sentinel's pad width negative
+    phys_rows = max(primary.indices.shape[0], other.indices.shape[0])
+    per_device = -(-phys_rows // data_size)
+    chunk = max(1, min(chunk, per_device))
+    # every device scans the same number of fixed-size chunks: pad the user
+    # universe so rows = data * chunks_per_device * chunk
+    chunks_per_device = -(-per_device // chunk)
+    rows = data_size * chunks_per_device * chunk
+    idx_p, msk_p = _pad_rows_sentinel(primary, rows)
+    fn = _build_cooc_fn(
+        mesh, chunk, primary.num_cols, other.num_cols,
+        primary.indices.shape[1], other.indices.shape[1],
+        top_k, llr, drop_diagonal, float(total),
     )
     from predictionio_tpu.parallel.mesh import fetch_global, put_global
 
-    sharding = NamedSharding(mesh, row)
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    rep = NamedSharding(mesh, PartitionSpec())
     put = lambda a: put_global(a, sharding)
-    return fetch_global(fn(put(idx_p), put(msk_p), put(idx_o), put(msk_o)))
+    g_idx_p, g_msk_p = put(idx_p), put(msk_p)
+    if other is primary:  # self-cooccurrence: one upload serves both sides
+        g_idx_o, g_msk_o = g_idx_p, g_msk_p
+    else:
+        idx_o, msk_o = _pad_rows_sentinel(other, rows)
+        g_idx_o, g_msk_o = put(idx_o), put(msk_o)
+    dummy = np.zeros(1, np.float32)
+    row_t = jax.device_put(
+        np.asarray(row_totals if row_totals is not None else dummy, np.float32),
+        rep,
+    )
+    col_t = jax.device_put(
+        np.asarray(col_totals if col_totals is not None else dummy, np.float32),
+        rep,
+    )
+    out = fn(g_idx_p, g_msk_p, g_idx_o, g_msk_o, row_t, col_t)
+    return jax.tree_util.tree_map(fetch_global, out)
+
+
+def _cooccurrence_mesh(primary: PaddedCSR, other: PaddedCSR, chunk: int, mesh):
+    return _run_cooc(primary, other, chunk, mesh)
 
 
 def distinct_user_counts(csr: PaddedCSR) -> np.ndarray:
@@ -183,8 +231,7 @@ def _xlogx(x):
     return jnp.where(x > 0, x * jnp.log(x), 0.0)
 
 
-@jax.jit
-def _llr_kernel(k11, row_totals, col_totals, total):
+def _llr_math(k11, row_totals, col_totals, total):
     """G^2 log-likelihood-ratio over the 2x2 contingency per (i, j) pair."""
     k12 = jnp.maximum(row_totals[:, None] - k11, 0.0)
     k21 = jnp.maximum(col_totals[None, :] - k11, 0.0)
@@ -195,6 +242,9 @@ def _llr_kernel(k11, row_totals, col_totals, total):
     h_total = _xlogx(k11 + k12 + k21 + k22)
     llr = 2.0 * (h_k + h_total - h_rows - h_cols)
     return jnp.where(k11 > 0, jnp.maximum(llr, 0.0), 0.0)
+
+
+_llr_kernel = jax.jit(_llr_math)
 
 
 def llr_scores(
@@ -212,6 +262,56 @@ def llr_scores(
             float(total),
         )
     )
+
+
+def cooccurrence_indicators(
+    primary: PaddedCSR,
+    other: PaddedCSR | None = None,
+    *,
+    top_k: int,
+    llr_row_totals: np.ndarray | None = None,
+    llr_col_totals: np.ndarray | None = None,
+    total: float | None = None,
+    drop_diagonal: bool | None = None,
+    chunk: int = 4096,
+    mesh=None,
+):
+    """Fused cooc -> (optional LLR) -> per-row top-k, entirely on device.
+
+    Returns ``(indices [items_p, k], values [items_p, k])`` like
+    :func:`top_k_sparsify`. Providing ``llr_row_totals``/``llr_col_totals``
+    (+ ``total``) applies the G^2 weighting before ranking. The unfused
+    chain fetches the [items_p, items_o] matrix to the host TWICE (once
+    after cooccurrence, once into top_k_sparsify) -- ~800 MB at 10k items,
+    seconds of pure transfer on a remote-tunnel backend -- where the fused
+    form downloads only the [items_p, k] indicator arrays.
+
+    Ties may rank in a different order than the host ``argpartition`` path;
+    the selected VALUES are identical.
+    """
+    self_cooc = other is None or other is primary
+    other, mesh = _normalize(primary, other, mesh)
+    if (llr_row_totals is None) != (llr_col_totals is None):
+        raise ValueError("provide both llr totals or neither")
+    if llr_row_totals is not None and total is None:
+        raise ValueError("LLR weighting needs the grand total")
+    if drop_diagonal is None:
+        drop_diagonal = self_cooc
+    if drop_diagonal and primary.num_cols != other.num_cols:
+        raise ValueError("drop_diagonal requires a square matrix")
+    idx, vals = _run_cooc(
+        primary,
+        other,
+        chunk,
+        mesh,
+        top_k=min(top_k, other.num_cols),
+        llr=llr_row_totals is not None,
+        drop_diagonal=drop_diagonal,
+        total=float(total or 0.0),
+        row_totals=llr_row_totals,
+        col_totals=llr_col_totals,
+    )
+    return np.asarray(idx), np.asarray(vals)
 
 
 def top_k_sparsify(matrix: np.ndarray, k: int, drop_diagonal: bool = True):

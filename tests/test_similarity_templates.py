@@ -17,6 +17,64 @@ from predictionio_tpu.workflow.context import RuntimeContext
 
 
 class TestCooccurrenceKernels:
+    def test_fused_indicators_match_unfused_chain(self):
+        """cooccurrence_indicators (on-device cooc -> LLR -> top-k) must
+        select the same values as the host chain, self- and cross-."""
+        from predictionio_tpu.ops.cooccurrence import (
+            cooccurrence_indicators,
+            distinct_user_counts,
+        )
+        from predictionio_tpu.parallel.mesh import local_mesh
+
+        rng = np.random.default_rng(5)
+        n_u, n_i = 60, 13
+        dense_a = (rng.random((n_u, n_i)) < 0.3).astype(np.float32)
+        dense_b = (rng.random((n_u, n_i)) < 0.25).astype(np.float32)
+        ua, ia = np.nonzero(dense_a)
+        ub, ib = np.nonzero(dense_b)
+        a = pack_padded_csr(ua, ia, np.ones(len(ua), np.float32), n_u, n_i)
+        b = pack_padded_csr(ub, ib, np.ones(len(ub), np.float32), n_u, n_i)
+        for mesh in (None, local_mesh(8, 1)):
+            # self-cooccurrence with LLR (similarproduct's configuration)
+            totals = distinct_user_counts(a)
+            f_idx, f_vals = cooccurrence_indicators(
+                a, top_k=5, llr_row_totals=totals, llr_col_totals=totals,
+                total=n_u, mesh=mesh, chunk=16,
+            )
+            llr = llr_scores(cooccurrence(a), totals, totals, total=n_u)
+            u_idx, u_vals = top_k_sparsify(llr, 5)
+            # ties may order differently; the selected VALUES must agree
+            np.testing.assert_allclose(
+                np.sort(f_vals, axis=1), np.sort(u_vals, axis=1), atol=1e-3
+            )
+            # cross-occurrence, raw counts, no diagonal drop
+            f_idx, f_vals = cooccurrence_indicators(
+                a, b, top_k=4, mesh=mesh, chunk=16
+            )
+            u_idx, u_vals = top_k_sparsify(
+                cooccurrence(a, b), 4, drop_diagonal=False
+            )
+            np.testing.assert_allclose(
+                np.sort(f_vals, axis=1), np.sort(u_vals, axis=1), atol=1e-4
+            )
+
+    def test_fused_indicators_validation(self):
+        from predictionio_tpu.ops.cooccurrence import cooccurrence_indicators
+
+        rng = np.random.default_rng(1)
+        uu, ii = np.nonzero((rng.random((20, 6)) < 0.4))
+        csr = pack_padded_csr(uu, ii, np.ones(len(uu), np.float32), 20, 6)
+        with pytest.raises(ValueError, match="both llr totals"):
+            cooccurrence_indicators(
+                csr, top_k=3, llr_row_totals=np.ones(6, np.float32)
+            )
+        with pytest.raises(ValueError, match="grand total"):
+            cooccurrence_indicators(
+                csr, top_k=3,
+                llr_row_totals=np.ones(6, np.float32),
+                llr_col_totals=np.ones(6, np.float32),
+            )
+
     def test_cooccurrence_matches_dense(self):
         rng = np.random.default_rng(0)
         n_u, n_i = 50, 12
