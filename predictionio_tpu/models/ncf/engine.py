@@ -12,8 +12,10 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from predictionio_tpu.controller import Engine, FirstServing, TPUAlgorithm
+from predictionio_tpu.models._als_common import topk_item_scores
 from predictionio_tpu.models.ncf.kernel import (
     make_all_items_scorer,
+    make_batch_scorer,
     reference_score_all_items,
 )
 from predictionio_tpu.models.ncf.model import (
@@ -52,11 +54,23 @@ class NCFModel:
     #: the model blob -- __getstate__ strips it and the first query after
     #: a deploy rebuilds it
     _scorer: object = field(default=None, init=False, repr=False, compare=False)
+    _batch_scorer: object = field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_scorer"] = None
+        state["_batch_scorer"] = None
         return state
+
+    def __setstate__(self, state):
+        # blobs pickled by older releases predate the scorer fields;
+        # dataclass unpickling bypasses __init__, so default them here or
+        # every access raises AttributeError
+        state.setdefault("_scorer", None)
+        state.setdefault("_batch_scorer", None)
+        self.__dict__.update(state)
 
     def scorer(self):
         # the query server is a ThreadingHTTPServer: concurrent first
@@ -76,6 +90,15 @@ class NCFModel:
                             self.params, u, n
                         )
         return self._scorer
+
+    def batch_scorer(self):
+        if self._batch_scorer is None:
+            with _SCORER_BUILD_LOCK:
+                if self._batch_scorer is None:
+                    self._batch_scorer = make_batch_scorer(
+                        self.params, len(self.item_ids)
+                    )
+        return self._batch_scorer
 
 
 class NCFAlgorithm(TPUAlgorithm):
@@ -126,12 +149,10 @@ class NCFAlgorithm(TPUAlgorithm):
             use_pallas=p.get_or("usePallas", backend not in ("cpu",)),
         )
 
-    def predict(self, model: NCFModel, query) -> dict:
-        num = int(query.get("num", 10))
-        user_idx = model.user_index.get(str(query.get("user")))
-        if user_idx is None:
-            return {"itemScores": []}
-        scores = model.scorer()(user_idx)
+    @staticmethod
+    def _topk_response(model: NCFModel, scores: np.ndarray, query, user_idx) -> dict:
+        """Shared exclusion + ranking tail (predict and batch_predict must
+        rank identically)."""
         exclude = {
             model.item_index[str(b)]
             for b in (query.get("blackList") or [])
@@ -142,14 +163,53 @@ class NCFAlgorithm(TPUAlgorithm):
         scores = scores.astype(np.float64)
         for j in exclude:
             scores[j] = -np.inf
-        order = np.argsort(-scores)[:num]
-        return {
-            "itemScores": [
-                {"item": model.item_ids[j], "score": float(scores[j])}
-                for j in order
-                if np.isfinite(scores[j])
-            ]
-        }
+        return topk_item_scores(
+            model.item_ids, scores, int(query.get("num", 10))
+        )
+
+    def predict(self, model: NCFModel, query) -> dict:
+        user_idx = model.user_index.get(str(query.get("user")))
+        if user_idx is None:
+            return {"itemScores": []}
+        return self._topk_response(model, model.scorer()(user_idx), query, user_idx)
+
+    def batch_predict(self, model: NCFModel, queries):
+        """Vectorized bulk scoring: chunks of known users score against the
+        full catalog in ONE device program each (make_batch_scorer),
+        instead of a 2-round-trip dispatch per query -- the reference's
+        P2LAlgorithm broadcast batchPredict, as XLA batching. Cold users
+        and malformed queries fall through to predict()."""
+        user_rows, fallback = [], []
+        for qid, q in queries:
+            user_idx = (
+                model.user_index.get(str(q["user"]))
+                if isinstance(q, dict) and "user" in q
+                else None
+            )
+            if user_idx is None:
+                fallback.append((qid, q))
+            else:
+                user_rows.append((qid, q, user_idx))
+        out = []
+        if user_rows:
+            # slice so the host-side [rows, items] score buffer stays
+            # ~200 MB f32 regardless of catalog size (same bound as the
+            # ALS batch path; the device-side pair budget caps only the
+            # on-device intermediates)
+            num_items = len(model.item_ids)
+            rows_per_slice = max(64, 50_000_000 // max(num_items, 1))
+            scorer = model.batch_scorer()
+            for start in range(0, len(user_rows), rows_per_slice):
+                part = user_rows[start : start + rows_per_slice]
+                scores = scorer(
+                    np.fromiter((u for _, _, u in part), dtype=np.int32)
+                )
+                out.extend(
+                    (qid, self._topk_response(model, scores[row], q, user_idx))
+                    for row, (qid, q, user_idx) in enumerate(part)
+                )
+        out.extend((qid, self.predict(model, q)) for qid, q in fallback)
+        return out
 
 
 def engine_factory() -> Engine:
