@@ -57,6 +57,15 @@ def build_seen(users: np.ndarray, items: np.ndarray) -> dict[int, set[int]]:
     return seen
 
 
+def score_buffer_rows(num_items: int, floor: int = 64, cap: int | None = None) -> int:
+    """Rows per batch-predict slice so the host [rows, items] score buffer
+    stays ~200 MB f32 regardless of catalog size (a fixed row count would
+    scale memory with num_items). One definition for every template's
+    batch path."""
+    rows = max(floor, 50_000_000 // max(num_items, 1))
+    return min(rows, cap) if cap else rows
+
+
 def topk_item_scores(item_ids: list[str], scores: np.ndarray, num: int) -> dict:
     """Rank + format tail shared by every template response: descending
     top-``num``, excluded entries carried as -inf and dropped here."""

@@ -12,7 +12,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from predictionio_tpu.controller import Engine, FirstServing, TPUAlgorithm
-from predictionio_tpu.models._als_common import topk_item_scores
+from predictionio_tpu.models._als_common import score_buffer_rows, topk_item_scores
 from predictionio_tpu.models.ncf.kernel import (
     make_all_items_scorer,
     make_batch_scorer,
@@ -192,12 +192,9 @@ class NCFAlgorithm(TPUAlgorithm):
                 user_rows.append((qid, q, user_idx))
         out = []
         if user_rows:
-            # slice so the host-side [rows, items] score buffer stays
-            # ~200 MB f32 regardless of catalog size (same bound as the
-            # ALS batch path; the device-side pair budget caps only the
-            # on-device intermediates)
-            num_items = len(model.item_ids)
-            rows_per_slice = max(64, 50_000_000 // max(num_items, 1))
+            # bound the host [rows, items] score buffer (the device-side
+            # pair budget caps only the on-device intermediates)
+            rows_per_slice = score_buffer_rows(len(model.item_ids))
             scorer = model.batch_scorer()
             for start in range(0, len(user_rows), rows_per_slice):
                 part = user_rows[start : start + rows_per_slice]

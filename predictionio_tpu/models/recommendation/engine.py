@@ -26,6 +26,7 @@ from predictionio_tpu.models._als_common import (
     build_seen,
     fit_with_checkpoint,
     prepare_als_data,
+    score_buffer_rows,
     topk_item_scores,
 )
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
@@ -224,11 +225,7 @@ class ALSAlgorithm(TPUAlgorithm):
                 user_rows.append((qid, q, user_idx))
         out = []
         if user_rows:
-            # slice so the [rows, items] score matrix stays ~200 MB f32
-            # regardless of catalog size (a fixed row count would scale
-            # memory with num_items)
-            num_items = model.als.item_factors.shape[0]
-            rows_per_slice = max(64, 50_000_000 // max(num_items, 1))
+            rows_per_slice = score_buffer_rows(model.als.item_factors.shape[0])
             for start in range(0, len(user_rows), rows_per_slice):
                 part = user_rows[start : start + rows_per_slice]
                 idxs = np.fromiter((u for _, _, u in part), dtype=np.int64)

@@ -27,9 +27,11 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.controller.base import SanityCheck
 from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models._als_common import score_buffer_rows, topk_item_scores
 from predictionio_tpu.models.sequence.model import (
     SASRecConfig,
     score_next_items,
+    score_next_items_batch,
     train_sasrec,
 )
 
@@ -206,10 +208,12 @@ class SASRecAlgorithm(TPUAlgorithm):
             histories=histories,
         )
 
-    def predict(self, model: SASRecModel, query) -> dict:
-        num = int(query.get("num", 10))
+    @staticmethod
+    def _resolve_prefix(model: SASRecModel, query):
+        """The sequence to continue: explicit ``items`` anchor or the user's
+        training history. None/empty means a cold query (empty response)."""
         if query.get("items"):
-            prefix = np.asarray(
+            return np.asarray(
                 [
                     model.item_index[str(i)] + 1
                     for i in query["items"]
@@ -217,14 +221,16 @@ class SASRecAlgorithm(TPUAlgorithm):
                 ],
                 np.int32,
             )
-        else:
-            prefix = model.histories.get(str(query.get("user")))
-        if prefix is None or len(prefix) == 0:
-            return {"itemScores": []}
-        scores = score_next_items(model.params, model.config, prefix).astype(
-            np.float64
+        return model.histories.get(str(query.get("user")))
+
+    @staticmethod
+    def _topk_response(model: SASRecModel, scores: np.ndarray, query, prefix) -> dict:
+        """Shared exclusion + ranking tail (predict and batch_predict must
+        rank identically)."""
+        scores = scores.astype(np.float64)
+        exclude = (
+            {int(i) - 1 for i in prefix} if query.get("unseenOnly", True) else set()
         )
-        exclude = {int(i) - 1 for i in prefix} if query.get("unseenOnly", True) else set()
         exclude |= {
             model.item_index[str(b)]
             for b in (query.get("blackList") or [])
@@ -232,14 +238,44 @@ class SASRecAlgorithm(TPUAlgorithm):
         }
         for j in exclude:
             scores[j] = -np.inf
-        order = np.argsort(-scores)[:num]
-        return {
-            "itemScores": [
-                {"item": model.item_ids[j], "score": float(scores[j])}
-                for j in order
-                if np.isfinite(scores[j])
-            ]
-        }
+        return topk_item_scores(model.item_ids, scores, int(query.get("num", 10)))
+
+    def predict(self, model: SASRecModel, query) -> dict:
+        prefix = self._resolve_prefix(model, query)
+        if prefix is None or len(prefix) == 0:
+            return {"itemScores": []}
+        scores = score_next_items(model.params, model.config, prefix)
+        return self._topk_response(model, scores, query, prefix)
+
+    def batch_predict(self, model: SASRecModel, queries):
+        """Vectorized bulk scoring: fixed-size slices of prefixes run the
+        transformer forward + vocab projection as ONE device program per
+        slice (score_next_items_batch) instead of two dispatches per
+        query. Cold/malformed queries fall through to predict()."""
+        resolved, fallback = [], []
+        for qid, q in queries:
+            prefix = self._resolve_prefix(model, q) if isinstance(q, dict) else None
+            if prefix is None or len(prefix) == 0:
+                fallback.append((qid, q))
+            else:
+                resolved.append((qid, q, prefix))
+        out = []
+        if resolved:
+            # bound the host [rows, vocab] buffer like the other batch
+            # paths; score_next_items_batch pads each slice to a power of
+            # two internally, so ragged tails don't recompile
+            rows = score_buffer_rows(len(model.item_ids), floor=16, cap=1024)
+            for start in range(0, len(resolved), rows):
+                part = resolved[start : start + rows]
+                scores = score_next_items_batch(
+                    model.params, model.config, [p for _, _, p in part]
+                )
+                out.extend(
+                    (qid, self._topk_response(model, scores[row], q, prefix))
+                    for row, (qid, q, prefix) in enumerate(part)
+                )
+        out.extend((qid, self.predict(model, q)) for qid, q in fallback)
+        return out
 
 
 def engine_factory() -> Engine:

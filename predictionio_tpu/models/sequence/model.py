@@ -247,33 +247,56 @@ def train_sasrec(
     return jax.tree_util.tree_map(fetch_global, params), losses
 
 
-_APPLY_CACHE: dict[SASRecConfig, object] = {}
+def _score_fn(config: SASRecConfig):
+    """Jitted forward + vocab projection in ONE program, cached per config.
 
-
-def _apply_fn(config: SASRecConfig):
-    """Jitted single-chip forward, cached per config (serving hot path)."""
-    if config not in _APPLY_CACHE:
+    Fusing the projection matters on remote-tunnel backends: the old path
+    dispatched the transformer forward and the [D] x [V, D] einsum as
+    separate eager calls, paying a round trip each, per query.
+    """
+    if config not in _SCORE_CACHE:
         model = SASRec(config, None)
-        _APPLY_CACHE[config] = jax.jit(
-            lambda params, seq: model.apply({"params": params}, seq)
-        )
-    return _APPLY_CACHE[config]
+
+        @jax.jit
+        def score(params, seqs, last):
+            hidden = model.apply({"params": params}, seqs)       # [B, T, D]
+            h_last = jnp.take_along_axis(
+                hidden, last[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0, :]                                           # [B, D]
+            return h_last @ params["item_embed"]["embedding"].T  # [B, V]
+
+        _SCORE_CACHE[config] = score
+    return _SCORE_CACHE[config]
+
+
+_SCORE_CACHE: dict = {}
+
+
+def score_next_items_batch(params, config: SASRecConfig, prefixes) -> np.ndarray:
+    """Scores over the item vocab for the next item after each prefix.
+
+    ``prefixes``: list of 1-D id arrays (no padding); each uses its last
+    max_len entries. Returns [B, num_items] (column i scores item id i+1 --
+    id 0 is the padding token and is dropped). The batch pads to the next
+    power of two internally, so arbitrary caller batch sizes compile at
+    most log2(max_B) distinct programs (<2x padded compute) instead of one
+    per size.
+    """
+    t = config.max_len
+    b = len(prefixes)
+    padded_b = 1 << (b - 1).bit_length() if b > 1 else 1
+    seqs = np.zeros((padded_b, t), np.int32)
+    last = np.zeros((padded_b,), np.int32)
+    for i, p in enumerate(prefixes):
+        tail = np.asarray(p, np.int32)[-t:]
+        seqs[i, : len(tail)] = tail
+        last[i] = max(len(tail) - 1, 0)
+    scores = np.asarray(
+        _score_fn(config)(params, jnp.asarray(seqs), jnp.asarray(last))
+    )
+    return scores[:b, 1:]
 
 
 def score_next_items(params, config: SASRecConfig, prefix: np.ndarray) -> np.ndarray:
-    """Scores over the item vocab for the next item after ``prefix``.
-
-    ``prefix``: 1-D array of item ids (no padding); uses the last max_len.
-    Returns [num_items] scores (score[i] is for item id i+1 -- id 0 is the
-    padding token and is dropped).
-    """
-    t = config.max_len
-    seq = np.zeros((1, t), np.int32)
-    tail = np.asarray(prefix, np.int32)[-t:]
-    seq[0, : len(tail)] = tail
-    last = max(len(tail) - 1, 0)
-    hidden = _apply_fn(config)(params, jnp.asarray(seq))
-    scores = np.asarray(
-        jnp.einsum("d,vd->v", hidden[0, last], params["item_embed"]["embedding"])
-    )
-    return scores[1:]
+    """Single-prefix convenience over :func:`score_next_items_batch`."""
+    return score_next_items_batch(params, config, [prefix])[0]

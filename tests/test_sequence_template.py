@@ -173,3 +173,47 @@ class TestSequenceEngine:
         train, info, pairs = folds[0]
         assert info.fold == 0
         assert pairs and all(len(actual) == 1 for _, actual in pairs)
+
+
+class TestSASRecBatchPredict:
+    def test_batch_matches_single(self, browsing_app):
+        """batch_predict (sliced one-program scoring) must rank exactly
+        like per-query predict, with cold users falling through."""
+        from predictionio_tpu.models.sequence.engine import engine_factory as ef
+
+        engine = ef()
+        ctx = RuntimeContext()
+        params = EngineParams.from_json_obj(
+            {
+                "datasource": {"params": {"appName": "ShopApp",
+                                          "eventNames": ["view"]}},
+                "preparator": {"params": {"maxLen": MAX_LEN}},
+                "algorithms": [
+                    {"name": "sasrec",
+                     "params": {"embedDim": 8, "numHeads": 2, "numBlocks": 1,
+                                "ffnDim": 16, "epochs": 2, "batchSize": 32}}
+                ],
+            }
+        )
+        models = engine.train(ctx, params)
+        algo = engine._algorithms(params)[0]
+        queries = [
+            (0, {"user": "u0", "num": 3}),
+            (1, {"items": ["i3", "i4"], "num": 4}),
+            (2, {"user": "ghost", "num": 2}),              # cold -> []
+            (3, {"user": "u1", "num": 5, "unseenOnly": False}),
+            (4, {"user": "u2", "num": 3, "blackList": ["i0"]}),
+        ]
+        batched = dict(algo.batch_predict(models[0], queries))
+        for qid, q in queries:
+            single = algo.predict(models[0], q)
+            assert [s["item"] for s in batched[qid]["itemScores"]] == [
+                s["item"] for s in single["itemScores"]
+            ], (qid, batched[qid], single)
+            np.testing.assert_allclose(
+                [s["score"] for s in batched[qid]["itemScores"]],
+                [s["score"] for s in single["itemScores"]],
+                rtol=1e-4,
+            )
+        assert batched[2] == {"itemScores": []}
+        assert "i0" not in {s["item"] for s in batched[4]["itemScores"]}
