@@ -66,6 +66,42 @@ def score_buffer_rows(num_items: int, floor: int = 64, cap: int | None = None) -
     return min(rows, cap) if cap else rows
 
 
+def partition_user_queries(user_index: dict[str, int], queries):
+    """Split (qid, query) pairs into known-user rows [(qid, q, user_idx)]
+    and fallback pairs [(qid, q)] -- the shared head of every template's
+    batch_predict."""
+    user_rows, fallback = [], []
+    for qid, q in queries:
+        user_idx = (
+            user_index.get(str(q["user"]))
+            if isinstance(q, dict) and "user" in q
+            else None
+        )
+        if user_idx is None:
+            fallback.append((qid, q))
+        else:
+            user_rows.append((qid, q, user_idx))
+    return user_rows, fallback
+
+
+def batch_score_known_users(als_model: ALSModel, user_rows, respond) -> list:
+    """Score known users in bounded [rows, items] matmul slices over the
+    host-cached factors; ``respond(scores_row, qid, query, user_idx)``
+    builds each response. One definition for every ALS-factor batch path.
+    """
+    out = []
+    rows_per_slice = score_buffer_rows(als_model.item_factors.shape[0])
+    for start in range(0, len(user_rows), rows_per_slice):
+        part = user_rows[start : start + rows_per_slice]
+        idxs = np.fromiter((u for _, _, u in part), dtype=np.int64)
+        scores = als_model.user_factors[idxs] @ als_model.item_factors.T
+        out.extend(
+            respond(scores[row], qid, q, user_idx)
+            for row, (qid, q, user_idx) in enumerate(part)
+        )
+    return out
+
+
 def topk_item_scores(item_ids: list[str], scores: np.ndarray, num: int) -> dict:
     """Rank + format tail shared by every template response: descending
     top-``num``, excluded entries carried as -inf and dropped here."""

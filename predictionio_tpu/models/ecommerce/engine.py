@@ -36,8 +36,10 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.base import SanityCheck
 from predictionio_tpu.data.store import LEventStore, PEventStore
 from predictionio_tpu.models._als_common import (
+    batch_score_known_users,
     build_seen,
     fit_with_checkpoint,
+    partition_user_queries,
     prepare_als_data,
     topk_item_scores,
 )
@@ -281,30 +283,17 @@ class ECommAlgorithm(TPUAlgorithm):
                 out.append(j)
         return out
 
-    def predict(self, model: ECommerceModel, query) -> dict:
-        num = int(query.get("num", 10))
-        user = str(query.get("user", ""))
-        if not user:
-            raise ValueError("query must contain 'user'")
-        user_idx = model.user_index.get(user)
-        anchors: list[int] = []
-        if user_idx is not None:
-            scores = model.als.score_items_for_user(user_idx)
-        else:
-            # cold user: anchor on live recently-viewed items; a user with
-            # no history at all gets empty (reference behavior)
-            anchors = self._recently_viewed(
-                model,
-                user,
-                int(query.get("recentCount", self.params.get_or("recentCount", 10))),
-            )
-            if not anchors:
-                return {"itemScores": []}
-            scores = np.zeros(len(model.item_ids), dtype=np.float32)
-            for a in anchors:
-                scores += model.als.similar_items(a)
-
-        # --- business rules -------------------------------------------
+    def _apply_rules(
+        self,
+        model: ECommerceModel,
+        scores: np.ndarray,
+        query,
+        user_idx,
+        anchors,
+        unavailable: set[int],
+    ) -> dict:
+        """Business-rule filtering + ranking shared by predict and
+        batch_predict (which resolves ``unavailable`` ONCE per batch)."""
         n_items = scores.shape[0]
         if query.get("whiteList"):
             allowed = np.zeros(n_items, dtype=bool)
@@ -326,7 +315,7 @@ class ECommAlgorithm(TPUAlgorithm):
             j = model.item_index.get(str(b))
             if j is not None:
                 exclude.add(j)
-        exclude |= self._unavailable_items(model)
+        exclude |= unavailable
         if user_idx is not None and query.get(
             "unseenOnly", self.params.get_or("unseenOnly", True)
         ):
@@ -334,7 +323,74 @@ class ECommAlgorithm(TPUAlgorithm):
         scores = np.where(allowed, scores, -np.inf)
         for j in exclude:
             scores[j] = -np.inf
-        return topk_item_scores(model.item_ids, scores, num)
+        return topk_item_scores(model.item_ids, scores, int(query.get("num", 10)))
+
+    def _cold_scores(self, model: ECommerceModel, query, user: str):
+        """(anchors, scores) for a user unseen at training time; anchors
+        empty means no history at all -> empty response."""
+        anchors = self._recently_viewed(
+            model,
+            user,
+            int(query.get("recentCount", self.params.get_or("recentCount", 10))),
+        )
+        if not anchors:
+            return [], None
+        scores = np.zeros(len(model.item_ids), dtype=np.float32)
+        for a in anchors:
+            scores += model.als.similar_items(a)
+        return anchors, scores
+
+    def predict(self, model: ECommerceModel, query) -> dict:
+        user = str(query.get("user", ""))
+        if not user:
+            raise ValueError("query must contain 'user'")
+        user_idx = model.user_index.get(user)
+        anchors: list[int] = []
+        if user_idx is not None:
+            scores = model.als.score_items_for_user(user_idx)
+        else:
+            anchors, scores = self._cold_scores(model, query, user)
+            if scores is None:
+                return {"itemScores": []}
+        return self._apply_rules(
+            model, scores, query, user_idx, anchors, self._unavailable_items(model)
+        )
+
+    def batch_predict(self, model: ECommerceModel, queries):
+        """Vectorized bulk scoring: known users score as sliced
+        [B, K] @ [K, items] matmuls over the host-cached factors, and the
+        live unavailable-items constraint is read ONCE per batch instead
+        of once per query. Cold users still do their per-user
+        recently-viewed lookup; malformed queries raise predict()'s error
+        through the fallback loop."""
+        user_rows, fallback = partition_user_queries(model.user_index, queries)
+        unavailable = self._unavailable_items(model) if queries else set()
+        out = batch_score_known_users(
+            model.als,
+            user_rows,
+            lambda scores, qid, q, user_idx: (
+                qid,
+                self._apply_rules(model, scores, q, user_idx, [], unavailable),
+            ),
+        )
+        for qid, q in fallback:
+            user = str(q.get("user", "")) if isinstance(q, dict) else ""
+            if not user:
+                out.append((qid, self.predict(model, q)))  # raises like predict
+                continue
+            anchors, scores = self._cold_scores(model, q, user)
+            if scores is None:
+                out.append((qid, {"itemScores": []}))
+            else:
+                out.append(
+                    (
+                        qid,
+                        self._apply_rules(
+                            model, scores, q, None, anchors, unavailable
+                        ),
+                    )
+                )
+        return out
 
 
 def engine_factory() -> Engine:

@@ -12,7 +12,11 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from predictionio_tpu.controller import Engine, FirstServing, TPUAlgorithm
-from predictionio_tpu.models._als_common import score_buffer_rows, topk_item_scores
+from predictionio_tpu.models._als_common import (
+    partition_user_queries,
+    score_buffer_rows,
+    topk_item_scores,
+)
 from predictionio_tpu.models.ncf.kernel import (
     make_all_items_scorer,
     make_batch_scorer,
@@ -179,17 +183,7 @@ class NCFAlgorithm(TPUAlgorithm):
         instead of a 2-round-trip dispatch per query -- the reference's
         P2LAlgorithm broadcast batchPredict, as XLA batching. Cold users
         and malformed queries fall through to predict()."""
-        user_rows, fallback = [], []
-        for qid, q in queries:
-            user_idx = (
-                model.user_index.get(str(q["user"]))
-                if isinstance(q, dict) and "user" in q
-                else None
-            )
-            if user_idx is None:
-                fallback.append((qid, q))
-            else:
-                user_rows.append((qid, q, user_idx))
+        user_rows, fallback = partition_user_queries(model.user_index, queries)
         out = []
         if user_rows:
             # bound the host [rows, items] score buffer (the device-side

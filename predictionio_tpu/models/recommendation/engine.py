@@ -23,10 +23,11 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.base import SanityCheck
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.models._als_common import (
+    batch_score_known_users,
     build_seen,
     fit_with_checkpoint,
+    partition_user_queries,
     prepare_als_data,
-    score_buffer_rows,
     topk_item_scores,
 )
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
@@ -211,34 +212,15 @@ class ALSAlgorithm(TPUAlgorithm):
         item-similarity queries fall back to predict(); malformed queries
         raise predict()'s normal error (the batch-predict workflow converts
         those to per-row error records)."""
-        user_rows = []  # (qid, query, user_idx)
-        fallback = []
-        for qid, q in queries:
-            user_idx = (
-                model.user_index.get(str(q["user"]))
-                if isinstance(q, dict) and "user" in q
-                else None
-            )
-            if user_idx is None:
-                fallback.append((qid, q))
-            else:
-                user_rows.append((qid, q, user_idx))
-        out = []
-        if user_rows:
-            rows_per_slice = score_buffer_rows(model.als.item_factors.shape[0])
-            for start in range(0, len(user_rows), rows_per_slice):
-                part = user_rows[start : start + rows_per_slice]
-                idxs = np.fromiter((u for _, _, u in part), dtype=np.int64)
-                scores = model.als.user_factors[idxs] @ model.als.item_factors.T
-                for row, (qid, q, user_idx) in enumerate(part):
-                    out.append(
-                        (
-                            qid,
-                            self._topk_response(
-                                model, scores[row], q, int(q.get("num", 10)), user_idx
-                            ),
-                        )
-                    )
+        user_rows, fallback = partition_user_queries(model.user_index, queries)
+        out = batch_score_known_users(
+            model.als,
+            user_rows,
+            lambda scores, qid, q, user_idx: (
+                qid,
+                self._topk_response(model, scores, q, int(q.get("num", 10)), user_idx),
+            ),
+        )
         out.extend((qid, self.predict(model, q)) for qid, q in fallback)
         return out
 

@@ -263,8 +263,10 @@ class SASRecAlgorithm(TPUAlgorithm):
         if resolved:
             # bound the host [rows, vocab] buffer like the other batch
             # paths; score_next_items_batch pads each slice to a power of
-            # two internally, so ragged tails don't recompile
+            # two internally, so round DOWN to one so full slices don't
+            # overshoot the buffer budget (625 -> 1024 would)
             rows = score_buffer_rows(len(model.item_ids), floor=16, cap=1024)
+            rows = 1 << (rows.bit_length() - 1)
             for start in range(0, len(resolved), rows):
                 part = resolved[start : start + rows]
                 scores = score_next_items_batch(

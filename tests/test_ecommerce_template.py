@@ -169,6 +169,45 @@ class TestECommerceEngine:
         got = {model.item_index[s["item"]] for s in result["itemScores"]}
         assert bought.isdisjoint(got)
 
+    def test_batch_predict_matches_predict(self, shop_app, storage_env):
+        """batch_predict must rank exactly like predict -- including the
+        live constraint (read once per batch), cold users, and filters."""
+        algo, model = train(make_params())
+        le = storage_env.get_l_events()
+        le.insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties=DataMap({"items": ["e0"]})),
+            app_id=shop_app,
+        )
+        le.insert(
+            Event(event="view", entity_type="user", entity_id="brandnew",
+                  target_entity_type="item", target_entity_id="e1"),
+            app_id=shop_app,
+        )
+        queries = [
+            (0, {"user": "g0u0", "num": 4, "unseenOnly": False}),
+            (1, {"user": "g1u0", "num": 3, "categories": ["clothing"]}),
+            (2, {"user": "brandnew", "num": 3}),           # cold w/ history
+            (3, {"user": "ghost", "num": 3}),              # cold, no history
+            (4, {"user": "g0u1", "num": 5, "blackList": ["e2"]}),
+        ]
+        batched = dict(algo.batch_predict(model, queries))
+        for qid, q in queries:
+            single = algo.predict(model, q)
+            # same items in the same order; scores equal up to the float
+            # accumulation difference between batched matmul and gemv
+            assert [s["item"] for s in batched[qid]["itemScores"]] == [
+                s["item"] for s in single["itemScores"]
+            ], (qid, batched[qid], single)
+            np.testing.assert_allclose(
+                [s["score"] for s in batched[qid]["itemScores"]],
+                [s["score"] for s in single["itemScores"]],
+                rtol=1e-4,
+            )
+        assert "e0" not in {s["item"] for s in batched[0]["itemScores"]}
+        assert batched[3] == {"itemScores": []}
+
     def test_eval_pairs_shape(self, shop_app):
         from predictionio_tpu.models.ecommerce.engine import ECommerceDataSource
 
