@@ -121,12 +121,17 @@ def run_als(platform: str, data, config, iters_to_time: int) -> float:
     transfer_s = time.perf_counter() - t0
 
     iteration = als_mod.make_iteration(mesh, config)
+    from jax.sharding import PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    reg = put_global(np.float32(config.reg), rep)
+    alpha = put_global(np.float32(config.alpha), rep)
 
     def sync(x) -> None:
         np.asarray(jax.device_get(x[:1, :1]))  # hard sync: forces the chain
 
     t0 = time.perf_counter()
-    uf, itf = iteration(*args, uf, itf)
+    uf, itf = iteration(*args, uf, itf, reg, alpha)
     sync(uf)
     compile_s = time.perf_counter() - t0
 
@@ -134,7 +139,7 @@ def run_als(platform: str, data, config, iters_to_time: int) -> float:
         nonlocal uf, itf
         t0 = time.perf_counter()
         for _ in range(iters_to_time):
-            uf, itf = iteration(*args, uf, itf)
+            uf, itf = iteration(*args, uf, itf, reg, alpha)
         sync(uf)
         return (time.perf_counter() - t0) / iters_to_time
 

@@ -96,7 +96,8 @@ def _half_step_explicit(indices, values, mask, factors, reg, rank, unroll):
     for the gather and half the ICI traffic for the all-gather; bf16 inputs
     are the MXU's native mode), while the Gram/rhs accumulate in f32 and
     the normal-equation solve runs in f32; the solution is cast back to the
-    factor dtype on return.
+    factor dtype on return. ``reg`` may be a traced scalar (the iteration
+    program is shared across regularization values -- see _build_iteration).
     """
     gathered = factors[indices]                       # [R, L, K]
     gathered = gathered * mask[..., None].astype(factors.dtype)
@@ -151,14 +152,17 @@ def _append_zero_row(factors: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_iteration(mesh, config: ALSConfig):
-    """The jitted full ALS iteration for (mesh, config) -- see _build_iteration."""
-    return _build_iteration(
-        mesh, config.rank, config.reg, config.alpha, config.implicit
-    )
+    """The jitted full ALS iteration for (mesh, config) -- see _build_iteration.
+
+    The returned callable takes the CSR args + factor buffers followed by
+    the ``reg`` and ``alpha`` scalars (runtime values; the compiled program
+    is shared across them).
+    """
+    return _build_iteration(mesh, config.rank, config.implicit)
 
 
 @functools.lru_cache(maxsize=32)
-def _build_iteration(mesh, rank: int, reg: float, alpha: float, implicit: bool):
+def _build_iteration(mesh, rank: int, implicit: bool):
     """Build the jitted full ALS iteration (both half-steps fused).
 
     CSR rows shard over the 'data' mesh axis; factor matrices live row-
@@ -167,8 +171,12 @@ def _build_iteration(mesh, rank: int, reg: float, alpha: float, implicit: bool):
     on-device XLA collective, not a host round-trip. Factor buffers are
     donated: each iteration updates in place instead of reallocating.
 
-    Cached by hyperparameters so repeated ``als_fit`` calls in one process
-    (serving retrains, benchmarks, grid evaluations) reuse the compilation.
+    ``reg``/``alpha`` are RUNTIME scalars, not baked constants: a
+    ``pio eval`` grid over lambda/alpha reuses one compiled program per
+    (mesh, rank, mode) instead of paying a full XLA compile per candidate
+    (minutes each on a remote-compile TPU backend). The remaining cache key
+    covers repeated ``als_fit`` calls in one process (serving retrains,
+    benchmarks).
     """
     row = NamedSharding(mesh, PartitionSpec("data"))
     rep = NamedSharding(mesh, PartitionSpec())
@@ -180,16 +188,16 @@ def _build_iteration(mesh, rank: int, reg: float, alpha: float, implicit: bool):
     # Any non-cpu platform counts as TPU-like: the axon tunnel backend
     # reports platform "axon" for real TPU chips.
     unroll = mesh.devices.flat[0].platform != "cpu"
-    if implicit:
-        step = functools.partial(
-            _half_step_implicit, reg=reg, alpha=alpha, rank=rank, unroll=unroll
-        )
-    else:
-        step = functools.partial(
-            _half_step_explicit, reg=reg, rank=rank, unroll=unroll
-        )
 
-    def iteration(u_idx, u_val, u_msk, i_idx, i_val, i_msk, users, items):
+    def iteration(u_idx, u_val, u_msk, i_idx, i_val, i_msk, users, items, reg, alpha):
+        if implicit:
+            step = functools.partial(
+                _half_step_implicit, reg=reg, alpha=alpha, rank=rank, unroll=unroll
+            )
+        else:
+            step = functools.partial(
+                _half_step_explicit, reg=reg, rank=rank, unroll=unroll
+            )
         items_full = jax.lax.with_sharding_constraint(_append_zero_row(items), rep)
         users = step(u_idx, u_val, u_msk, items_full)
         users_full = jax.lax.with_sharding_constraint(_append_zero_row(users), rep)
@@ -198,7 +206,7 @@ def _build_iteration(mesh, rank: int, reg: float, alpha: float, implicit: bool):
 
     return jax.jit(
         iteration,
-        in_shardings=(row, row, row, row, row, row, row, row),
+        in_shardings=(row,) * 8 + (rep, rep),
         out_shardings=(row, row),
         donate_argnums=(6, 7),
     )
@@ -313,10 +321,18 @@ def als_fit(
     item_factors = put_row(items0.astype(dtype))
 
     iteration = make_iteration(mesh, config)
+    # globally-replicated scalars: a process-local jnp scalar cannot feed a
+    # jit whose sharding spans other processes' devices (multi-host train)
+    from predictionio_tpu.parallel.mesh import replicated
+
+    rep = replicated(mesh)
+    reg = put_global(np.float32(config.reg), rep)
+    alpha = put_global(np.float32(config.alpha), rep)
 
     for it in range(start_iteration, config.iterations):
         user_factors, item_factors = iteration(
-            u_idx, u_val, u_msk, i_idx, i_val, i_msk, user_factors, item_factors
+            u_idx, u_val, u_msk, i_idx, i_val, i_msk, user_factors, item_factors,
+            reg, alpha,
         )
         if (
             callback is not None

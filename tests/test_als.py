@@ -74,6 +74,33 @@ class TestExplicitALS:
         )
         assert out.dtype == jnp.bfloat16
 
+    def test_grid_candidates_share_one_compiled_program(self):
+        """reg/alpha are runtime scalars: a pio-eval grid over lambda must
+        reuse ONE compiled iteration per (mesh, rank, mode), not compile
+        per candidate (minutes each on a remote-compile TPU backend)."""
+        from predictionio_tpu.parallel.als import make_iteration
+        from predictionio_tpu.parallel.mesh import local_mesh
+
+        mesh = local_mesh(1, 1)
+        a = make_iteration(mesh, ALSConfig(rank=6, reg=0.01))
+        b = make_iteration(mesh, ALSConfig(rank=6, reg=0.5, alpha=2.0))
+        assert a is b
+        assert a is not make_iteration(mesh, ALSConfig(rank=8, reg=0.01))
+
+    def test_reg_still_regularizes(self, synthetic):
+        """The traced-scalar reg must actually flow into the solve: a huge
+        lambda shrinks the factors toward zero."""
+        n_u, n_i, uu, ii, rr, _ = synthetic
+        small = ALSConfig(rank=6, iterations=4, reg=0.01, seed=1)
+        large = ALSConfig(rank=6, iterations=4, reg=1000.0, seed=1)
+        data = build_als_data(uu, ii, rr, n_u, n_i, small)
+        m_small = als_fit(data, small, local_mesh(1, 1))
+        m_large = als_fit(data, large, local_mesh(1, 1))
+        assert (
+            np.abs(m_large.user_factors).mean()
+            < 0.1 * np.abs(m_small.user_factors).mean()
+        )
+
     def test_invalid_factor_dtype_rejected(self, synthetic):
         n_u, n_i, uu, ii, rr, _ = synthetic
         cfg = ALSConfig(rank=6, iterations=1, dtype="int8")
