@@ -17,7 +17,9 @@ def ensure_backend(platform: str | None = None) -> str:
     """Make sure SOME JAX backend initializes; returns its platform name.
 
     Resolution order: explicit ``platform`` arg > ``PIO_PLATFORM`` env >
-    JAX default, falling back to CPU when the preferred backend fails.
+    JAX default. When that fails, retry with the known accelerator list
+    ``"tpu,cpu"`` (a configured name may simply not be registered in this
+    process), then settle for CPU.
     """
     import jax
 
@@ -27,6 +29,24 @@ def ensure_backend(platform: str | None = None) -> str:
     try:
         return jax.devices()[0].platform
     except RuntimeError as exc:
-        logger.warning("accelerator backend unavailable (%s); using CPU", exc)
-        jax.config.update("jax_platforms", "cpu")
-        return jax.devices()[0].platform
+        # the configured platform list can name a plugin that never
+        # registered in THIS process (observed: a site hook pins
+        # jax_platforms="axon,cpu" while the TPU backend registers under
+        # "tpu" -- and whether "axon" registers at all depends on the
+        # working directory). Retry the KNOWN accelerator names rather
+        # than "" (auto): auto-selection initializes every registered
+        # plugin, and a registered-but-wedged tunnel plugin blocks
+        # indefinitely on init -- the failure mode this function exists to
+        # keep out of the CLI/servers. libtpu's init fails fast when no
+        # local TPU is attached, so "tpu,cpu" is a bounded probe.
+        logger.warning(
+            "configured backend unavailable (%s); retrying tpu,cpu",
+            exc,
+        )
+        try:
+            jax.config.update("jax_platforms", "tpu,cpu")
+            return jax.devices()[0].platform
+        except RuntimeError as exc2:
+            logger.warning("accelerator backend unavailable (%s); using CPU", exc2)
+            jax.config.update("jax_platforms", "cpu")
+            return jax.devices()[0].platform

@@ -351,3 +351,55 @@ class TestBatchPredict:
             for i in range(len(want) - 1):
                 if want[i]["score"] - want[i + 1]["score"] > 1e-4:
                     assert got[i]["item"] == want[i]["item"], (q, i)
+
+
+class TestEnsureBackend:
+    def test_retries_auto_selection_before_cpu(self, monkeypatch):
+        """A configured platform list naming an unregistered plugin (the
+        cwd-dependent tunnel hook) must retry automatic selection -- which
+        can still find a real accelerator -- before settling for CPU.
+        The retry list is the bounded "tpu,cpu" probe, NOT auto-selection,
+        which would initialize (and hang on) a wedged tunnel plugin."""
+        import jax
+
+        import predictionio_tpu.utils.platform as plat
+
+        class Dev:
+            platform = "tpu"
+
+        state = {"calls": 0}
+
+        def fake_devices():
+            state["calls"] += 1
+            if state["calls"] == 1:
+                raise RuntimeError("Unable to initialize backend 'axon'")
+            return [Dev()]
+
+        updates = []
+        monkeypatch.setattr(jax, "devices", fake_devices)
+        monkeypatch.setattr(jax.config, "update", lambda k, v: updates.append((k, v)))
+        assert plat.ensure_backend() == "tpu"
+        assert ("jax_platforms", "tpu,cpu") in updates
+        assert ("jax_platforms", "cpu") not in updates
+
+    def test_falls_back_to_cpu_when_nothing_initializes(self, monkeypatch):
+        import jax
+
+        import predictionio_tpu.utils.platform as plat
+
+        class Dev:
+            platform = "cpu"
+
+        state = {"calls": 0}
+
+        def fake_devices():
+            state["calls"] += 1
+            if state["calls"] <= 2:  # configured AND auto selection fail
+                raise RuntimeError("no backend")
+            return [Dev()]
+
+        updates = []
+        monkeypatch.setattr(jax, "devices", fake_devices)
+        monkeypatch.setattr(jax.config, "update", lambda k, v: updates.append((k, v)))
+        assert plat.ensure_backend() == "cpu"
+        assert updates[-1] == ("jax_platforms", "cpu")
