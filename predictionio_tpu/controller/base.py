@@ -126,6 +126,11 @@ class Algorithm(Component, Generic[PD, M, Q, P]):
         """Default: loop predict. Override with a vectorized/jitted version."""
         return [(qid, self.predict(model, q)) for qid, q in queries]
 
+    def warm_up(self, model: M) -> None:
+        """Called once at deploy after the model is rehydrated. Override to
+        build serving caches (device-resident tables, compiled programs) so
+        the first query doesn't pay for them. Must be safe to skip."""
+
     # -- query/result wire serde (CustomQuerySerializer parity role) --------
     def query_from_json(self, obj: Any) -> Q:
         """Deserialize a /queries.json body. Default: pass the dict through."""

@@ -208,6 +208,22 @@ class Engine:
                 models.append(retrained[i])
             else:  # pragma: no cover - corrupted blob
                 raise ValueError(f"unknown model persistence kind {kind!r}")
+        for algorithm, model in zip(algorithms, models):
+            # serving caches (device-resident scorers, compiled programs)
+            # build at deploy time, not on the unlucky first query. STRICTLY
+            # best-effort: a model trained for an accelerator may deploy
+            # onto a CPU-fallback host (wedged plugin) where the cache
+            # build raises -- serving must still come up; the failing path
+            # surfaces per-query instead
+            try:
+                algorithm.warm_up(model)
+            except Exception:
+                logger.warning(
+                    "warm_up failed for %s; first queries will build serving"
+                    " caches lazily",
+                    type(algorithm).__name__,
+                    exc_info=True,
+                )
         return models
 
     # -- eval ---------------------------------------------------------------

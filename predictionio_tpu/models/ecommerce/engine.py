@@ -283,6 +283,9 @@ class ECommAlgorithm(TPUAlgorithm):
                 out.append(j)
         return out
 
+    def warm_up(self, model: ECommerceModel) -> None:
+        model.als.item_norms  # cold-user similarity norm cache, at deploy
+
     def _apply_rules(
         self,
         model: ECommerceModel,

@@ -55,8 +55,8 @@ class NCFModel:
     use_pallas: bool
     #: lazily-built device-resident scorer (tables uploaded once); holds
     #: device buffers and a jit closure, so it must never be pickled into
-    #: the model blob -- __getstate__ strips it and the first query after
-    #: a deploy rebuilds it
+    #: the model blob -- __getstate__ strips it and deploy rebuilds it via
+    #: NCFAlgorithm.warm_up (a cold query would otherwise pay the build)
     _scorer: object = field(default=None, init=False, repr=False, compare=False)
     _batch_scorer: object = field(
         default=None, init=False, repr=False, compare=False
@@ -152,6 +152,14 @@ class NCFAlgorithm(TPUAlgorithm):
             seen=seen,
             use_pallas=p.get_or("usePallas", backend not in ("cpu",)),
         )
+
+    def warm_up(self, model: NCFModel) -> None:
+        """Build both serving scorers at deploy (tables upload + kernel
+        compile), not on the first unlucky query: /queries.json serves
+        through scorer(), the batch-predict workflow through
+        batch_scorer() -- prepare_deploy precedes both."""
+        model.scorer()
+        model.batch_scorer()
 
     @staticmethod
     def _topk_response(model: NCFModel, scores: np.ndarray, query, user_idx) -> dict:

@@ -196,6 +196,9 @@ class ALSAlgorithm(TPUAlgorithm):
             seen=seen,
         )
 
+    def warm_up(self, model: RecommendationModel) -> None:
+        model.als.item_norms  # build the similar-items norm cache at deploy
+
     def predict(self, model: RecommendationModel, query) -> dict:
         num = int(query.get("num", 10))
         if "user" in query:

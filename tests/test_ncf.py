@@ -220,3 +220,43 @@ class TestNCFEngine:
         assert batched[2] == {"itemScores": []}
         black = {s["item"] for s in batched[3]["itemScores"]}
         assert black.isdisjoint({"i0", "i1"})
+
+    def test_deploy_warms_the_scorer(self, storage_env):
+        """prepare_deploy must build the serving scorer eagerly (warm_up)
+        so the first query after a deploy doesn't pay table upload +
+        compile; the pickled blob itself must never carry it."""
+        import pickle
+
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.models.ncf import engine_factory
+        from predictionio_tpu.workflow.context import RuntimeContext
+
+        app_id = storage_env.get_meta_data_apps().insert(App(name="NcfWarm"))
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        rng = np.random.default_rng(1)
+        le.batch_insert(
+            [
+                Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({"rating": float(rng.integers(1, 6))}))
+                for u in range(8) for i in rng.choice(6, 3, replace=False)
+            ],
+            app_id=app_id,
+        )
+        ep = EngineParams.from_json_obj(
+            {"datasource": {"params": {"appName": "NcfWarm"}},
+             "algorithms": [{"name": "ncf", "params": {
+                 "embedDim": 4, "hidden": [8, 4], "epochs": 2, "batchSize": 8}}]}
+        )
+        engine = engine_factory()
+        ctx = RuntimeContext()
+        models = engine.train(ctx, ep)
+        blob = engine.serialize_models(ctx, ep, "iid", models)
+        deployed = engine.prepare_deploy(ctx, ep, "iid", blob)
+        assert deployed[0]._scorer is not None        # warmed at deploy
+        assert deployed[0]._batch_scorer is not None  # batchpredict path too
+        # and the blob round-trip stripped it (no device buffers pickled)
+        assert pickle.loads(pickle.dumps(models[0]))._scorer is None
