@@ -1,0 +1,100 @@
+"""docs/tutorial.md promises "Every snippet is runnable as shown" -- this
+test enforces it by EXTRACTING the tutorial's code blocks (the engine
+module, engine.json, and the evaluation module) and driving them through
+the real workflow: ingest -> train -> predict -> eval. Doc drift fails
+here, not on a reader."""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import STATUS_COMPLETED, App
+from predictionio_tpu.workflow.core_workflow import run_evaluation, run_train
+from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+_DOC = os.path.join(os.path.dirname(__file__), "..", "docs", "tutorial.md")
+
+
+def _blocks(lang: str) -> list[str]:
+    text = open(_DOC).read()
+    return re.findall(rf"```{lang}\n(.*?)```", text, re.S)
+
+
+@pytest.fixture()
+def likes_app(storage_env):
+    """The tutorial's LikesApp: u0..u7 like items; i7 is the most liked."""
+    app_id = storage_env.get_meta_data_apps().insert(App(name="LikesApp"))
+    le = storage_env.get_l_events()
+    le.init_channel(app_id)
+    events = []
+    for u in range(8):
+        for i in {0: [1, 7], 1: [7, 3], 2: [7], 3: [2, 7], 4: [5],
+                  5: [7, 5], 6: [3], 7: [7, 2]}[u]:
+            events.append(
+                Event(event="like", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({}))
+            )
+    le.batch_insert(events, app_id=app_id)
+    return app_id
+
+
+@pytest.fixture()
+def engine_dir(tmp_path):
+    """The tutorial's engine directory, built from the doc's own blocks."""
+    py = _blocks("python")
+    assert len(py) == 2, "tutorial should have exactly 2 python blocks"
+    js = _blocks("json")
+    assert len(js) == 1, "tutorial should have exactly 1 json block"
+    d = tmp_path / "my-likes-engine"
+    d.mkdir()
+    (d / "likes_engine.py").write_text(py[0])
+    (d / "likes_eval.py").write_text(py[1])
+    (d / "engine.json").write_text(js[0])
+    sys.path.insert(0, str(d))
+    yield d
+    sys.path.remove(str(d))
+    for mod in ("likes_engine", "likes_eval"):
+        sys.modules.pop(mod, None)
+
+
+class TestTutorialRunsAsShown:
+    def test_engine_json_matches_factory(self, engine_dir):
+        cfg = json.loads((engine_dir / "engine.json").read_text())
+        assert cfg["engineFactory"] == "likes_engine.factory"
+        assert cfg["algorithms"] == [{"name": "popularity", "params": {}}]
+
+    def test_train_and_predict(self, likes_app, engine_dir):
+        variant = load_engine_variant(str(engine_dir / "engine.json"))
+        instance = run_train(variant)
+        assert instance.status == STATUS_COMPLETED
+
+        import likes_engine
+
+        engine = likes_engine.factory()
+        params = variant.engine_params
+        models = engine.train(__import__(
+            "predictionio_tpu.workflow.context", fromlist=["RuntimeContext"]
+        ).RuntimeContext(), params)
+        algo = engine._algorithms(params)[0]
+        # i7 is the most liked item; u4 never liked it -> it tops their recs
+        out = algo.predict(models[0], {"user": "u4", "num": 3})
+        assert out["itemScores"][0]["item"] == "i7"
+        # u0 already liked i7 -> excluded
+        out0 = algo.predict(models[0], {"user": "u0", "num": 3})
+        assert "i7" not in [s["item"] for s in out0["itemScores"]]
+
+    def test_eval_module_runs_the_grid(self, likes_app, engine_dir):
+        import likes_eval
+
+        instance = run_evaluation(
+            likes_eval.evaluation,
+            likes_eval.paramsgen,
+            evaluation_class="likes_eval.evaluation",
+            generator_class="likes_eval.paramsgen",
+        )
+        assert instance.status == STATUS_COMPLETED
