@@ -12,6 +12,7 @@ This module grows with the framework; verbs are registered in
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from predictionio_tpu.version import __version__
@@ -39,6 +40,45 @@ def cmd_status(args: argparse.Namespace) -> int:
     from predictionio_tpu.data import storage
 
     print(f"pio (predictionio_tpu) {__version__}")
+    # the accelerator is this framework's execution substrate (the role
+    # SPARK_HOME verification played in the reference's `pio status`).
+    # Probe it in a BOUNDED subprocess: initializing a registered-but-
+    # wedged tunnel plugin blocks forever, and the diagnostic command a
+    # user runs to debug a broken setup must always answer.
+    import subprocess
+
+    probe = (
+        "from predictionio_tpu.utils.platform import ensure_backend\n"
+        "import jax\n"
+        "p = ensure_backend()\n"
+        "ds = jax.devices()\n"
+        "print('PIO_ACCEL|' + p + '|' + str(len(ds)) + '|' + ds[0].device_kind)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True,
+            text=True,
+            timeout=float(os.environ.get("PIO_STATUS_PROBE_TIMEOUT_S", "60")),
+        )
+        fields = next(
+            (
+                line.split("|")
+                for line in proc.stdout.splitlines()
+                if line.startswith("PIO_ACCEL|")
+            ),
+            None,
+        )
+        if fields is None:
+            print("Accelerator: probe failed -- training will fall back to CPU")
+        elif fields[1] == "cpu":
+            print("Accelerator: none (CPU backend) -- training and serving"
+                  " run on the host")
+        else:
+            print(f"Accelerator: {fields[1]} x{fields[2]} ({fields[3]})")
+    except subprocess.TimeoutExpired:
+        print("Accelerator: probe timed out -- the accelerator plugin may be"
+              " wedged; trains fall back to CPU (utils/platform ladder)")
     print("Storage configuration:")
     for repo, cfg in storage.config_summary().items():
         detail = ", ".join(f"{k}={v}" for k, v in cfg.items() if k not in ("source",))

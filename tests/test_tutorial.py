@@ -13,7 +13,13 @@ import pytest
 
 from predictionio_tpu.data import DataMap, Event
 from predictionio_tpu.data.storage.base import STATUS_COMPLETED, App
-from predictionio_tpu.workflow.core_workflow import run_evaluation, run_train
+from predictionio_tpu.workflow.context import RuntimeContext
+from predictionio_tpu.workflow.core_workflow import (
+    engine_params_from_instance,
+    resolve_engine_instance,
+    run_evaluation,
+    run_train,
+)
 from predictionio_tpu.workflow.json_extractor import load_engine_variant
 
 _DOC = os.path.join(os.path.dirname(__file__), "..", "docs", "tutorial.md")
@@ -68,7 +74,10 @@ class TestTutorialRunsAsShown:
         assert cfg["engineFactory"] == "likes_engine.factory"
         assert cfg["algorithms"] == [{"name": "popularity", "params": {}}]
 
-    def test_train_and_predict(self, likes_app, engine_dir):
+    def test_train_persist_deploy_predict(self, likes_app, engine_dir, storage_env):
+        """The doc's sections 5-6: pio-train core persists the model, the
+        deploy path rehydrates it from the model STORE (not a fresh
+        in-memory train), and predictions serve from the rehydrated model."""
         variant = load_engine_variant(str(engine_dir / "engine.json"))
         instance = run_train(variant)
         assert instance.status == STATUS_COMPLETED
@@ -76,10 +85,13 @@ class TestTutorialRunsAsShown:
         import likes_engine
 
         engine = likes_engine.factory()
-        params = variant.engine_params
-        models = engine.train(__import__(
-            "predictionio_tpu.workflow.context", fromlist=["RuntimeContext"]
-        ).RuntimeContext(), params)
+        resolved = resolve_engine_instance(variant)
+        assert resolved.id == instance.id
+        params = engine_params_from_instance(resolved)
+        blob = storage_env.get_model_data_models().get(resolved.id)
+        models = engine.prepare_deploy(
+            RuntimeContext(), params, resolved.id, blob.models
+        )
         algo = engine._algorithms(params)[0]
         # i7 is the most liked item; u4 never liked it -> it tops their recs
         out = algo.predict(models[0], {"user": "u4", "num": 3})
