@@ -1,0 +1,119 @@
+"""Client SDK against live in-process servers: the wire contract the
+reference ecosystem's Python SDK spoke (SURVEY §1 L7 / Appendix A),
+exercised through EventClient/EngineClient instead of raw requests."""
+
+import pytest
+
+from predictionio_tpu.client import EngineClient, EventClient, PIOServerError
+from predictionio_tpu.data.api.eventserver import create_event_server
+from predictionio_tpu.data.storage.base import AccessKey, App
+
+
+@pytest.fixture()
+def event_server(storage_env):
+    apps = storage_env.get_meta_data_apps()
+    app_id = apps.insert(App(name="SdkApp"))
+    key = storage_env.get_meta_data_access_keys().insert(
+        AccessKey(key="", app_id=app_id)
+    )
+    storage_env.get_l_events().init_channel(app_id)
+    svc = create_event_server(host="127.0.0.1", port=0).start()
+    yield f"http://127.0.0.1:{svc.port}", key, app_id
+    svc.stop()
+
+
+class TestEventClient:
+    def test_create_get_find_delete(self, event_server):
+        base, key, _ = event_server
+        c = EventClient(base, access_key=key)
+        eid = c.create(event="rate", entity_type="user", entity_id="u1",
+                       target_entity_type="item", target_entity_id="i1",
+                       properties={"rating": 4})
+        got = c.get(eid)
+        assert got["event"] == "rate" and got["properties"]["rating"] == 4
+        found = c.find(event="rate")
+        assert [e["eventId"] for e in found] == [eid]
+        c.delete(eid)
+        with pytest.raises(PIOServerError) as err:
+            c.get(eid)
+        assert err.value.status == 404
+
+    def test_property_helpers_aggregate(self, event_server, storage_env):
+        base, key, app_id = event_server
+        c = EventClient(base, access_key=key)
+        c.set_properties("item", "i9", {"categories": ["a", "b"], "price": 3})
+        c.unset_properties("item", "i9", ["price"])
+        props = storage_env.get_l_events().aggregate_properties(
+            app_id=app_id, entity_type="item"
+        )
+        assert props["i9"].get("categories") == ["a", "b"]
+        assert "price" not in props["i9"]
+        c.delete_entity("item", "i9")
+        props = storage_env.get_l_events().aggregate_properties(
+            app_id=app_id, entity_type="item"
+        )
+        assert "i9" not in props
+
+    def test_batch_and_auth_errors(self, event_server):
+        base, key, _ = event_server
+        c = EventClient(base, access_key=key)
+        statuses = c.create_batch(
+            [
+                {"event": "buy", "entityType": "user", "entityId": "u2",
+                 "targetEntityType": "item", "targetEntityId": "i2"},
+                {"event": "$bad", "entityType": "user", "entityId": "u2"},
+            ]
+        )
+        assert statuses[0]["status"] == 201 and statuses[1]["status"] == 400
+        bad = EventClient(base, access_key="wrong")
+        with pytest.raises(PIOServerError) as err:
+            bad.create(event="x", entity_type="user", entity_id="u")
+        assert err.value.status == 401
+
+
+class TestEngineClient:
+    def test_query_roundtrip(self, storage_env, tmp_path):
+        """Train the tutorial-grade fake engine, serve it, query via the
+        client -- the reference EngineClient.send_query contract."""
+        import os
+        import sys
+
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.workflow.core_workflow import run_train
+        from predictionio_tpu.workflow.create_server import create_query_server
+        from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+        tests_dir = os.path.dirname(os.path.abspath(__file__))
+        if tests_dir not in sys.path:
+            sys.path.insert(0, tests_dir)
+        apps = storage_env.get_meta_data_apps()
+        app_id = apps.insert(App(name="RateApp"))
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        le.batch_insert(
+            [
+                Event(event="rate", entity_type="user", entity_id="u1",
+                      target_entity_type="item", target_entity_id="i1",
+                      properties=DataMap({"rating": 4.0}))
+            ],
+            app_id=app_id,
+        )
+        import json as _json
+
+        variant_path = tmp_path / "engine.json"
+        variant_path.write_text(_json.dumps({
+            "id": "default",
+            "engineFactory": "fake_engine.engine_factory",
+            "datasource": {"params": {"appName": "RateApp"}},
+            "algorithms": [{"name": "mean", "params": {}}],
+        }))
+        variant = load_engine_variant(str(variant_path))
+        run_train(variant)
+        thread, service = create_query_server(variant, host="127.0.0.1", port=0)
+        thread.start()
+        try:
+            client = EngineClient(f"http://127.0.0.1:{thread.port}")
+            out = client.query({"user": "u1"})
+            assert out == {"rating": 4.0}  # FakeAlgorithm: global mean
+        finally:
+            thread.stop()
