@@ -37,6 +37,18 @@ class PIOServerError(RuntimeError):
         self.body = body
 
 
+class PIOConnectionError(PIOServerError):
+    """The server could not be reached at all (refused, DNS, timeout).
+
+    Subclasses PIOServerError so SDK users have ONE error hierarchy to
+    catch; ``status`` is 0 because no HTTP response exists."""
+
+    def __init__(self, reason: str):
+        RuntimeError.__init__(self, f"connection failed: {reason}")
+        self.status = 0
+        self.body = ""
+
+
 def _request(
     method: str, url: str, payload: Any | None = None, timeout: float = 10.0
 ) -> Any:
@@ -50,6 +62,10 @@ def _request(
             body = resp.read().decode()
     except urllib.error.HTTPError as exc:
         raise PIOServerError(exc.code, exc.read().decode()) from None
+    except (urllib.error.URLError, OSError) as exc:
+        # URLError wraps refused/DNS; bare OSError covers socket timeouts
+        # and resets mid-read -- all "never reached a response" failures
+        raise PIOConnectionError(str(exc)) from None
     return json.loads(body) if body else None
 
 
@@ -87,7 +103,9 @@ class EventClient:
             body["targetEntityType"] = target_entity_type
         if target_entity_id is not None:
             body["targetEntityId"] = target_entity_id
-        if properties:
+        if properties is not None:
+            # an explicit {} must survive to the wire: an empty $set is a
+            # legal "touch" (updates lastUpdated) and differs from no field
             body["properties"] = properties
         if event_time is not None:
             body["eventTime"] = (

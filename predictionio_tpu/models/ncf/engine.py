@@ -76,6 +76,45 @@ class NCFModel:
         state.setdefault("_batch_scorer", None)
         self.__dict__.update(state)
 
+    def _pallas_with_fallback(self):
+        """Pallas all-items scorer that degrades to the XLA reference path.
+
+        A model trained with usePallas=True can deploy onto a host whose
+        backend cannot lower the kernel (CPU fallback after an accelerator
+        outage). Build failures and first-call lowering failures both log
+        once and permanently swap in reference_score_all_items -- a
+        working slower path beats a serving endpoint that 500s forever.
+        """
+        import logging
+
+        n = len(self.item_ids)
+        log = logging.getLogger("pio.ncf")
+        try:
+            fast = make_all_items_scorer(self.params, n, interpret=False)
+        except Exception as exc:
+            log.warning(
+                "Pallas scorer build failed (%s); serving via the XLA "
+                "reference path", exc,
+            )
+            return lambda u: reference_score_all_items(self.params, u, n)
+        def score(user_idx):
+            nonlocal fast
+            if fast is not None:
+                try:
+                    return fast(user_idx)
+                except Exception as exc:
+                    # drop the dead scorer so its device-resident tables
+                    # (full embedding + MLP uploads) are freed, not pinned
+                    # for the model's serving lifetime on a degraded host
+                    fast = None
+                    log.warning(
+                        "Pallas scorer failed at call time (%s); falling "
+                        "back to the XLA reference path permanently", exc,
+                    )
+            return reference_score_all_items(self.params, user_idx, n)
+
+        return score
+
     def scorer(self):
         # the query server is a ThreadingHTTPServer: concurrent first
         # queries must not each upload the tables and compile the kernel
@@ -85,9 +124,7 @@ class NCFModel:
             with _SCORER_BUILD_LOCK:
                 if self._scorer is None:
                     if self.use_pallas:
-                        self._scorer = make_all_items_scorer(
-                            self.params, len(self.item_ids), interpret=False
-                        )
+                        self._scorer = self._pallas_with_fallback()
                     else:
                         n = len(self.item_ids)
                         self._scorer = lambda u: reference_score_all_items(

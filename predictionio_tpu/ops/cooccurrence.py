@@ -18,13 +18,13 @@ LLR is then elementwise.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.ops.ragged import PaddedCSR
+from predictionio_tpu.parallel.mesh import cached_by_mesh
 
 
 def _dense_onehot(indices, mask, num_cols: int):
@@ -84,7 +84,7 @@ def _pad_rows_sentinel(csr: PaddedCSR, rows: int) -> tuple[np.ndarray, np.ndarra
     return indices, mask
 
 
-@functools.lru_cache(maxsize=64)
+@cached_by_mesh(maxsize=64)
 def _build_cooc_fn(
     mesh,
     chunk: int,

@@ -17,7 +17,6 @@ zoo leans on). TPU-first shape:
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 
 import jax
@@ -25,8 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from predictionio_tpu.parallel.mesh import cached_by_mesh
 
-@functools.lru_cache(maxsize=32)
+
+@cached_by_mesh(maxsize=32)
 def _build_step(mesh, k: int):
     row = NamedSharding(mesh, PartitionSpec("data"))
     rep = NamedSharding(mesh, PartitionSpec())

@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from predictionio_tpu.ops.linalg import batched_spd_solve
 from predictionio_tpu.ops.ragged import PaddedCSR, pack_padded_csr
+from predictionio_tpu.parallel.mesh import cached_by_mesh
 
 
 @dataclass
@@ -161,7 +162,7 @@ def make_iteration(mesh, config: ALSConfig):
     return _build_iteration(mesh, config.rank, config.implicit)
 
 
-@functools.lru_cache(maxsize=32)
+@cached_by_mesh(maxsize=32)
 def _build_iteration(mesh, rank: int, implicit: bool):
     """Build the jitted full ALS iteration (both half-steps fused).
 

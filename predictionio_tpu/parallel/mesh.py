@@ -7,9 +7,25 @@ replicated arrays use an empty PartitionSpec.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def cached_by_mesh(maxsize: int = 32):
+    """LRU cache for ``build(mesh, *static_args)`` program builders.
+
+    ``jax.sharding.Mesh`` hashes BY VALUE (axis names + devices + shape +
+    axis types), so an lru_cache keyed on the mesh deduplicates the fresh-
+    but-equivalent meshes that long-lived serving/eval processes construct
+    per retrain: one compiled program per distinct topology. The retention
+    this implies is deliberate and bounded -- at most ``maxsize`` compiled
+    programs (plus the tiny Mesh keys; devices are process-lifetime
+    singletons anyway), evicted LRU. Thread-safe (lru_cache's internal
+    lock; serving is a threaded HTTP server)."""
+    return functools.lru_cache(maxsize=maxsize)
 
 
 def local_mesh(data: int | None = None, model: int = 1) -> Mesh:

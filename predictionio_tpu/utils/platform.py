@@ -13,40 +13,63 @@ import os
 logger = logging.getLogger("pio.platform")
 
 
-def ensure_backend(platform: str | None = None) -> str:
+def ensure_backend(platform: str | None = None, fallback: bool = False) -> str:
     """Make sure SOME JAX backend initializes; returns its platform name.
 
     Resolution order: explicit ``platform`` arg > ``PIO_PLATFORM`` env >
-    JAX default. When that fails, retry with the known accelerator list
-    ``"tpu,cpu"`` (a configured name may simply not be registered in this
-    process), then settle for CPU.
+    JAX default. When the failing name came from the JAX default/site
+    config, the degradation ladder always applies (retry ``"tpu,cpu"``,
+    then settle for CPU). When the caller explicitly named a platform, an
+    unavailable backend RAISES by default -- a typo'd ``PIO_PLATFORM``
+    must not silently train/serve elsewhere -- unless ``fallback=True``:
+    the long-running service entry points (deploy serving, the training
+    workflow) opt in so a persisted ``pio.platform`` pin outlives an
+    accelerator outage, with a prominent warning instead of a dead server.
+    Callers can also pin a list (``PIO_PLATFORM=tpu,cpu``) to allow
+    specific fallbacks without opting into CPU.
     """
     import jax
 
     want = platform or os.environ.get("PIO_PLATFORM")
     if want:
         jax.config.update("jax_platforms", want)
-    try:
-        return jax.devices()[0].platform
-    except RuntimeError as exc:
-        # the configured platform list can name a plugin that never
-        # registered in THIS process (observed: a site hook pins
-        # jax_platforms="axon,cpu" while the TPU backend registers under
-        # "tpu" -- and whether "axon" registers at all depends on the
-        # working directory). Retry the KNOWN accelerator names rather
-        # than "" (auto): auto-selection initializes every registered
-        # plugin, and a registered-but-wedged tunnel plugin blocks
-        # indefinitely on init -- the failure mode this function exists to
-        # keep out of the CLI/servers. libtpu's init fails fast when no
-        # local TPU is attached, so "tpu,cpu" is a bounded probe.
-        logger.warning(
-            "configured backend unavailable (%s); retrying tpu,cpu",
-            exc,
-        )
         try:
-            jax.config.update("jax_platforms", "tpu,cpu")
             return jax.devices()[0].platform
-        except RuntimeError as exc2:
-            logger.warning("accelerator backend unavailable (%s); using CPU", exc2)
-            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError as exc:
+            if not fallback:
+                raise RuntimeError(
+                    f"explicitly requested JAX platform {want!r} (via "
+                    f"{'platform arg' if platform else 'PIO_PLATFORM'}) is "
+                    f"unavailable: {exc}"
+                ) from exc
+            logger.warning(
+                "pinned platform %r unavailable (%s); degrading because "
+                "fallback=True", want, exc,
+            )
+    else:
+        try:
             return jax.devices()[0].platform
+        except RuntimeError as exc:
+            # the configured platform list can name a plugin that never
+            # registered in THIS process (observed: a site hook pins
+            # jax_platforms="axon,cpu" while the TPU backend registers
+            # under "tpu" -- and whether "axon" registers at all depends
+            # on the working directory). Fall through to the bounded
+            # ladder below.
+            logger.warning(
+                "configured backend unavailable (%s); retrying tpu,cpu",
+                exc,
+            )
+    # shared degradation ladder. Retry the KNOWN accelerator names rather
+    # than "" (auto): auto-selection initializes every registered plugin,
+    # and a registered-but-wedged tunnel plugin blocks indefinitely on
+    # init -- the failure mode this function exists to keep out of the
+    # CLI/servers. libtpu's init fails fast when no local TPU is
+    # attached, so "tpu,cpu" is a bounded probe.
+    try:
+        jax.config.update("jax_platforms", "tpu,cpu")
+        return jax.devices()[0].platform
+    except RuntimeError as exc2:
+        logger.warning("accelerator backend unavailable (%s); using CPU", exc2)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform

@@ -120,8 +120,11 @@ class QueryService:
         engine_params = engine_params_from_instance(instance)
         # resolve the instance FIRST so an explicit pio.platform in its
         # runtime conf wins; serving must come up even with a wedged
-        # accelerator plugin (ensure_backend falls back to CPU)
-        ensure_backend((instance.runtime_conf or {}).get("pio.platform"))
+        # accelerator plugin, so this call site opts into the degradation
+        # ladder (fallback=True) -- availability over pin fidelity here
+        ensure_backend(
+            (instance.runtime_conf or {}).get("pio.platform"), fallback=True
+        )
         blob_record = storage.get_model_data_models().get(instance.id)
         ctx = RuntimeContext(instance.runtime_conf)
         models = self.engine.prepare_deploy(

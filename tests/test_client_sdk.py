@@ -70,6 +70,31 @@ class TestEventClient:
             bad.create(event="x", entity_type="user", entity_id="u")
         assert err.value.status == 401
 
+    def test_empty_properties_survive_to_the_wire(self):
+        """set_properties(..., {}) is a legal empty $set (touches
+        lastUpdated); the body must carry "properties": {} rather than
+        dropping the field."""
+        body = EventClient._event_body(
+            event="$set", entity_type="user", entity_id="u1", properties={}
+        )
+        assert body["properties"] == {}
+        assert "properties" not in EventClient._event_body(
+            event="buy", entity_type="user", entity_id="u1"
+        )
+
+    def test_connection_failures_are_pio_errors(self):
+        """Unreachable servers surface as PIOConnectionError (a
+        PIOServerError subclass, status 0) -- one hierarchy to catch, not
+        urllib internals."""
+        from predictionio_tpu.client import PIOConnectionError
+
+        # TEST-NET port that nothing listens on; connection refused fast
+        c = EventClient("http://127.0.0.1:9", access_key="k", timeout=2.0)
+        with pytest.raises(PIOConnectionError) as err:
+            c.create(event="buy", entity_type="user", entity_id="u1")
+        assert err.value.status == 0
+        assert isinstance(err.value, PIOServerError)
+
 
 class TestEngineClient:
     def test_query_roundtrip(self, storage_env, tmp_path):

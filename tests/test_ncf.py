@@ -53,6 +53,34 @@ class TestPallasKernel:
         np.testing.assert_allclose(via_model, via_ref, rtol=1e-4, atol=1e-5)
 
 
+class TestServingFallback:
+    def test_pallas_scorer_falls_back_on_cpu(self, tiny_params):
+        """A model trained with usePallas=True that deploys onto a host
+        whose backend cannot lower the kernel must serve through the XLA
+        reference path (permanently, after one logged failure) instead of
+        500-ing every /queries.json call."""
+        from predictionio_tpu.models.ncf.engine import NCFModel
+
+        config, params = tiny_params
+        model = NCFModel(
+            params=params,
+            user_index={"u0": 0},
+            item_ids=[f"i{j}" for j in range(config.num_items)],
+            item_index={f"i{j}": j for j in range(config.num_items)},
+            seen={},
+            use_pallas=True,  # on the CPU test backend Mosaic can't lower
+        )
+        got = np.asarray(model.scorer()(3))
+        want = reference_score_all_items(params, 3, config.num_items)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+        # and the swap is sticky: a second call goes straight to fallback
+        got2 = np.asarray(model.scorer()(5))
+        np.testing.assert_allclose(
+            got2, reference_score_all_items(params, 5, config.num_items),
+            rtol=2e-4, atol=2e-5,
+        )
+
+
 class TestTraining:
     def _clique_data(self, n_users=32, n_items=16):
         rng = np.random.default_rng(0)

@@ -403,3 +403,46 @@ class TestEnsureBackend:
         monkeypatch.setattr(jax.config, "update", lambda k, v: updates.append((k, v)))
         assert plat.ensure_backend() == "cpu"
         assert updates[-1] == ("jax_platforms", "cpu")
+
+    def test_explicit_platform_fails_loudly(self, monkeypatch):
+        """An explicitly named platform (arg or PIO_PLATFORM) that cannot
+        initialize must raise, not silently degrade to another accelerator:
+        a typo'd pin would otherwise train/serve elsewhere with only a log
+        line. Callers who want fallback can pin a list ("tpu,cpu")."""
+        import jax
+
+        import predictionio_tpu.utils.platform as plat
+
+        def fake_devices():
+            raise RuntimeError("Unable to initialize backend 'tqu'")
+
+        monkeypatch.setattr(jax, "devices", fake_devices)
+        monkeypatch.setattr(jax.config, "update", lambda k, v: None)
+        with pytest.raises(RuntimeError, match="explicitly requested"):
+            plat.ensure_backend("tqu")
+        monkeypatch.setenv("PIO_PLATFORM", "tqu")
+        with pytest.raises(RuntimeError, match="PIO_PLATFORM"):
+            plat.ensure_backend()
+
+    def test_service_call_sites_opt_into_fallback(self, monkeypatch):
+        """Long-running services (deploy serving, the training workflow)
+        pass fallback=True: a persisted pio.platform pin must outlive an
+        accelerator outage -- degrade with a warning, not a dead server."""
+        import jax
+
+        import predictionio_tpu.utils.platform as plat
+
+        class Dev:
+            platform = "cpu"
+
+        state = {"calls": 0}
+
+        def fake_devices():
+            state["calls"] += 1
+            if state["calls"] == 1:  # the pinned platform fails ...
+                raise RuntimeError("Unable to initialize backend 'tpu'")
+            return [Dev()]  # ... and the ladder finds the host backend
+
+        monkeypatch.setattr(jax, "devices", fake_devices)
+        monkeypatch.setattr(jax.config, "update", lambda k, v: None)
+        assert plat.ensure_backend("tpu", fallback=True) == "cpu"
