@@ -785,10 +785,43 @@ class SQLLEvents(base.LEvents):
         expression, so string/bool ratings come back NULL exactly like the
         row path's isinstance check. ``event_times_iso`` carries the stored
         ISO8601 strings (full microsecond precision; event_time_ms would
-        truncate sub-ms ordering the row path preserves). Same time
-        ordering as ``find`` (event_time_ms ASC). At ML-20M scale this is
-        the difference between seconds and minutes of ``pio train`` read
-        time.
+        truncate sub-ms ordering the row path preserves). Time-ordered like
+        ``find`` (event_time_ms ASC, event_id tie-break). At ML-20M scale
+        this is the difference between seconds and minutes of ``pio
+        train`` read time.
+        """
+        cols: tuple[list, ...] = ([], [], [], [], [])
+        for chunk in self.iter_interaction_chunks(
+            app_id=app_id,
+            channel_id=channel_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            start_time=start_time,
+            until_time=until_time,
+            rating_key=rating_key,
+        ):
+            for acc, part in zip(cols, chunk):
+                acc.extend(part)
+        return cols
+
+    def iter_interaction_chunks(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        event_names: list[str] | None = None,
+        target_entity_type=...,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        rating_key: str = "rating",
+        chunk_rows: int = 262_144,
+    ):
+        """``scan_interactions`` as a bounded-memory stream: yields the same
+        five columns in chunks of at most ``chunk_rows`` rows, riding the
+        dialect's streaming cursor (server-side for Postgres) instead of
+        materializing the full result. Ordering is DETERMINISTIC across
+        repeated scans and across processes (event_time_ms, event_id) --
+        the sharded multi-host reader replays this stream on every process
+        and must assign identical vocabulary ids and identical tie-breaks.
         """
         select = (
             "SELECT entity_id, target_entity_id, event, event_time,"
@@ -809,16 +842,13 @@ class SQLLEvents(base.LEvents):
             event_names=event_names,
             target_entity_type=target_entity_type,
         )
-        sql.append("ORDER BY event_time_ms ASC")
-        ents: list = []
-        tgts: list = []
-        names: list = []
-        times: list = []
-        ratings: list = []
+        sql.append("ORDER BY event_time_ms ASC, event_id ASC")
+        cols: tuple[list, ...] = ([], [], [], [], [])
         for r in self.c.query_iter(self.c.sql(" ".join(sql)), tuple(params)):
-            ents.append(r[0])
-            tgts.append(r[1])
-            names.append(r[2])
-            times.append(r[3])
-            ratings.append(r[4])
-        return ents, tgts, names, times, ratings
+            for acc, v in zip(cols, r):
+                acc.append(v)
+            if len(cols[0]) >= chunk_rows:
+                yield cols
+                cols = ([], [], [], [], [])
+        if cols[0]:
+            yield cols

@@ -31,7 +31,12 @@ def prepare_als_data(
     times: np.ndarray,
 ):
     """Pack COO interactions into padded CSR blocks sized for ctx's mesh."""
-    config = ALSConfig(max_len=params.get_or("maxEventsPerUser", None))
+    config = ALSConfig(
+        max_len=params.get_or("maxEventsPerUser", None),
+        # length-bucketed packing: engine.json "buckets" (default 1 keeps
+        # the single-block layout; the ML-20M bench uses 4)
+        buckets=params.get_or("buckets", 1),
+    )
     num_shards = 1
     try:
         num_shards = ctx.mesh.shape.get("data", 1)

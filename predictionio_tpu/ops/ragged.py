@@ -52,6 +52,7 @@ def pack_padded_csr(
     times: np.ndarray | None = None,
     len_multiple: int = 8,
     row_multiple: int = 8,
+    pad_len: int | None = None,
 ) -> PaddedCSR:
     """COO (rows, cols, vals) -> PaddedCSR.
 
@@ -59,13 +60,16 @@ def pack_padded_csr(
     - ``times`` (same length) lets truncation keep the most recent entries.
     - lengths round up to ``len_multiple`` and rows to ``row_multiple`` so
       the arrays tile cleanly (TPU lanes want the trailing dims aligned).
+    - ``pad_len`` forces the padded length instead of deriving it from the
+      data: multi-process builds pack only local rows, and every process
+      must agree on the block shape even when its local maximum is shorter.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
     vals = np.asarray(vals, dtype=np.float32)
     if rows.size == 0:
         padded_rows = max(round_up(max(num_rows, 1), row_multiple), row_multiple)
-        length = len_multiple
+        length = pad_len or len_multiple
         return PaddedCSR(
             indices=np.full((padded_rows, length), num_cols, dtype=np.int32),
             values=np.zeros((padded_rows, length), dtype=np.float32),
@@ -77,8 +81,16 @@ def pack_padded_csr(
 
     counts = np.bincount(rows, minlength=num_rows)
     natural_max = int(counts.max())
-    length = min(natural_max, max_len) if max_len else natural_max
-    length = max(round_up(length, len_multiple), len_multiple)
+    if pad_len is not None:
+        if natural_max > pad_len and not max_len:
+            raise ValueError(
+                f"pad_len={pad_len} is shorter than the longest row "
+                f"({natural_max}) and no max_len truncation was requested"
+            )
+        length = pad_len
+    else:
+        length = min(natural_max, max_len) if max_len else natural_max
+        length = max(round_up(length, len_multiple), len_multiple)
 
     padded_rows = max(round_up(num_rows, row_multiple), row_multiple)
     indices = np.full((padded_rows, length), num_cols, dtype=np.int32)

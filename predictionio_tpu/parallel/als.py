@@ -7,9 +7,18 @@ code). Design anchor: ALX (arxiv 2112.02194, PAPERS.md), "ALS on TPUs":
 
 - interactions live as padded CSR blocks (``ops.ragged``): static shapes,
   gathers instead of ragged loops;
+- rows are LENGTH-BUCKETED: each side's entities are relabeled into
+  length-sorted slots and split into a few buckets, each bucket its own
+  padded block with its own (much tighter) padded length. At ML-20M's
+  history distribution one global pad length wastes ~25-35% of gather
+  slots on padding; bucketing recovers most of that as iteration time.
+  The opposite side's column ids are slot-mapped at pack time, so the
+  device math never sees the permutation -- ``slot_of`` maps factors
+  back to original entity order at the host boundary only;
 - each half-step solves all rows' K x K normal equations as one batched
-  Cholesky on the MXU: Gram via ``einsum`` over the padded gather, masked;
-- sharding: rows of the padded CSR shard over the ``data`` mesh axis; the
+  Cholesky per bucket on the MXU: Gram via ``einsum`` over the padded
+  gather, masked;
+- sharding: every bucket's rows shard over the ``data`` mesh axis; the
   opposite-side factor matrix is replicated (XLA all-gathers it once per
   half-step -- the collective that replaces MLlib's factor-block shuffle);
 - implicit-feedback mode (MLlib ``trainImplicit`` parity) uses the YtY trick:
@@ -32,7 +41,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from predictionio_tpu.ops.linalg import batched_spd_solve
-from predictionio_tpu.ops.ragged import PaddedCSR, pack_padded_csr
+from predictionio_tpu.ops.ragged import PaddedCSR, pack_padded_csr, round_up
 from predictionio_tpu.parallel.mesh import cached_by_mesh
 
 
@@ -46,14 +55,236 @@ class ALSConfig:
     seed: int = 0
     max_len: int | None = None  # per-row history cap (SURVEY 5.7)
     dtype: str = "float32"     # factor dtype; Grams always accumulate f32
+    buckets: int = 1           # length buckets per side (1 = single block)
+    #: "replicated": the opposite-side factor matrix is all-gathered whole
+    #: per half-step (fine while a catalog fits one device's HBM).
+    #: "model": ALX block model-parallelism -- factors shard over the
+    #: ``model`` mesh axis, each device gathers only its local hits, and a
+    #: psum_scatter over ``model`` completes the sum; per-device factor
+    #: memory drops to total_slots/model_axis rows (see docs/parallelism.md
+    #: for the max-catalog math). Requires build_als_data(model_shards=m).
+    factor_sharding: str = "replicated"
+
+
+@dataclass
+class BucketedCSR:
+    """One side's interactions as length-bucketed padded CSR blocks.
+
+    Block ``b`` covers factor-matrix slots ``[offset_b, offset_b +
+    padded_rows_b)``; real rows are deterministically SCATTERED across the
+    block's padded range (multi-host load balance -- see _plan_buckets),
+    padding rows carry zero mask wherever they fall. ``slot_of[original_
+    id]`` is the factor row the entity occupies; built with ``buckets=1``
+    the slot map is the identity and the single block equals the
+    pre-bucketing layout.
+    ``indices`` entries are the OPPOSITE side's slots; padding slots carry
+    the sentinel ``opposite.total_slots`` (callers append one zero row to
+    the gathered factor matrix so padding gathers stay in-bounds).
+    """
+
+    blocks: tuple[PaddedCSR, ...]
+    slot_of: np.ndarray  # int64 [num_rows]: original row id -> factor slot
+    num_rows: int        # real (original) row count
+    total_slots: int     # sum of the blocks' padded row counts
+    #: set by the SHARDED reader (parallel.reader): blocks then hold only
+    #: this process's data-axis rows and these are the GLOBAL per-bucket
+    #: padded row counts used to assemble the device arrays via
+    #: make_array_from_process_local_data. None = blocks are global.
+    global_rows: tuple[int, ...] | None = None
+    #: edges this process retained after the partitioned scan (the
+    #: memory-scaling evidence the sharded-reader tests assert on)
+    retained_edges: int = 0
+
+    @property
+    def truncated(self) -> int:
+        return sum(b.truncated for b in self.blocks)
+
+    @property
+    def padded_slots(self) -> int:
+        """Total gather slots (the quantity bucketing minimizes)."""
+        return sum(int(np.prod(b.indices.shape)) for b in self.blocks)
+
+    def _single(self) -> PaddedCSR:
+        if len(self.blocks) != 1:
+            raise ValueError(
+                "flat accessors are only defined for single-bucket data; "
+                f"this side has {len(self.blocks)} buckets"
+            )
+        return self.blocks[0]
+
+    # single-bucket compatibility accessors (tests / direct kernel drivers)
+    @property
+    def indices(self) -> np.ndarray:
+        return self._single().indices
+
+    @property
+    def values(self) -> np.ndarray:
+        return self._single().values
+
+    @property
+    def mask(self) -> np.ndarray:
+        return self._single().mask
 
 
 @dataclass
 class ALSData:
     """Both orientations of the interaction matrix, padded for the mesh."""
 
-    by_row: PaddedCSR  # users x items
-    by_col: PaddedCSR  # items x users
+    by_row: BucketedCSR  # users x items
+    by_col: BucketedCSR  # items x users
+
+
+@dataclass
+class _BucketPlan:
+    order: np.ndarray      # original ids in slot order (real rows only)
+    sizes: list[int]       # real rows per bucket
+    offsets: list[int]     # first slot of each bucket
+    slot_of: np.ndarray    # [num_rows]
+    total_slots: int
+    lengths: list[int]     # padded L per bucket (every process must agree)
+
+    @property
+    def padded_rows(self) -> list[int]:
+        ends = self.offsets[1:] + [self.total_slots]
+        return [e - o for o, e in zip(self.offsets, ends)]
+
+
+def _plan_buckets(
+    counts: np.ndarray,
+    cap: int | None,
+    n_buckets: int,
+    row_multiple: int,
+    len_multiple: int = 8,
+) -> _BucketPlan:
+    """Partition rows into <=``n_buckets`` length buckets minimizing the
+    total padded slot count sum_b padded_rows_b * padded_len_b.
+
+    Rows are sorted by (capped) length descending; candidate cut points
+    are the positions where the 8-rounded length drops (<= cap/8 + 1 of
+    them, so the exact DP over candidates is tiny). Using FEWER buckets
+    than allowed is considered too: each bucket pays a row-roundup tax.
+    """
+    n = counts.size
+
+    def padded_len(raw: int) -> int:
+        capped_max = min(raw, cap) if cap else raw
+        return max(round_up(capped_max, len_multiple), len_multiple)
+
+    if n_buckets <= 1 or n <= 1:
+        total = max(round_up(max(n, 1), row_multiple), row_multiple)
+        return _BucketPlan(
+            order=np.arange(n, dtype=np.int64),
+            sizes=[n],
+            offsets=[0],
+            slot_of=np.arange(n, dtype=np.int64),
+            total_slots=total,
+            lengths=[padded_len(int(counts.max()) if n else 0)],
+        )
+
+    capped = np.minimum(counts, cap) if cap else counts
+    order = np.argsort(-capped, kind="stable").astype(np.int64)
+    rounded = np.maximum(
+        ((capped[order] + len_multiple - 1) // len_multiple) * len_multiple,
+        len_multiple,
+    )
+    cuts = list(np.nonzero(np.diff(rounded) != 0)[0] + 1)
+    cand = [0] + cuts + [n]
+    if len(cand) > 66:  # cap DP size for absurd max_len; keep ends exact
+        step = (len(cand) - 2) // 64 + 1
+        cand = [0] + cand[1:-1][::step] + [n]
+
+    def seg_cost(i: int, j: int) -> int:
+        rows = cand[j] - cand[i]
+        return round_up(rows, row_multiple) * int(rounded[cand[i]])
+
+    m = len(cand) - 1
+    inf = float("inf")
+    dp = [[inf] * (m + 1) for _ in range(n_buckets + 1)]
+    back: list[list[int]] = [[0] * (m + 1) for _ in range(n_buckets + 1)]
+    dp[0][0] = 0.0
+    for b in range(1, n_buckets + 1):
+        for j in range(1, m + 1):
+            for i in range(j):
+                if dp[b - 1][i] == inf:
+                    continue
+                cost = dp[b - 1][i] + seg_cost(i, j)
+                if cost < dp[b][j]:
+                    dp[b][j] = cost
+                    back[b][j] = i
+    b_best = min(range(1, n_buckets + 1), key=lambda b: dp[b][m])
+    bounds = [m]
+    b, j = b_best, m
+    while b > 0:
+        j = back[b][j]
+        bounds.append(j)
+        b -= 1
+    bounds.reverse()  # candidate indices 0 = start .. m = end
+
+    sizes, offsets, lengths = [], [], []
+    slot_of = np.empty(n, dtype=np.int64)
+    off = 0
+    for b, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:])):
+        size = cand[hi] - cand[lo]
+        sizes.append(size)
+        offsets.append(off)
+        lengths.append(int(rounded[cand[lo]]))
+        # deterministic scatter over the bucket's WHOLE padded range: in
+        # length-sorted front-packed order, every bucket's heaviest rows
+        # (and all its real rows, when padding is substantial) would land
+        # in the FIRST contiguous data shards -- process 0 of a multi-host
+        # mesh would retain most of the edge set. Scattering costs nothing
+        # (the padded length is the bucket's, order-independent), keeps
+        # the slot map a plan-level fact every process derives identically
+        # from the same counts, and balances both edge retention and
+        # per-shard solve work.
+        padded_b = max(round_up(size, row_multiple), row_multiple)
+        perm = np.random.default_rng(0x5EED + b).permutation(padded_b)[:size]
+        slot_of[order[cand[lo] : cand[hi]]] = off + perm
+        off += padded_b
+    return _BucketPlan(
+        order=order, sizes=sizes, offsets=offsets, slot_of=slot_of,
+        total_slots=off, lengths=lengths,
+    )
+
+
+def _pack_side(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    times: np.ndarray | None,
+    plan: _BucketPlan,
+    opp_total_slots: int,
+    opp_slot_of: np.ndarray,
+    cap: int | None,
+    row_multiple: int,
+) -> BucketedCSR:
+    """Pack one orientation into its bucket blocks (slot-mapped columns)."""
+    row_slots = plan.slot_of[rows]
+    cols_slotted = opp_slot_of[cols]
+    blocks = []
+    for off, padded, length in zip(
+        plan.offsets, plan.padded_rows, plan.lengths
+    ):
+        sel = (row_slots >= off) & (row_slots < off + padded)
+        blocks.append(
+            pack_padded_csr(
+                row_slots[sel] - off,
+                cols_slotted[sel],
+                vals[sel],
+                num_rows=padded,
+                num_cols=opp_total_slots,
+                max_len=cap,
+                times=None if times is None else times[sel],
+                row_multiple=row_multiple,
+                pad_len=length,
+            )
+        )
+    return BucketedCSR(
+        blocks=tuple(blocks),
+        slot_of=plan.slot_of,
+        num_rows=int(plan.slot_of.shape[0]),
+        total_slots=plan.total_slots,
+    )
 
 
 def build_als_data(
@@ -65,16 +296,35 @@ def build_als_data(
     config: ALSConfig,
     times: np.ndarray | None = None,
     num_shards: int = 1,
+    model_shards: int = 1,
 ) -> ALSData:
-    """Pack COO interactions into both CSR orientations, row counts padded
-    to multiples of 8 * num_shards so every shard is equal AND lane-aligned
-    (max(8, n) breaks for shard counts like 6 that don't divide 8)."""
-    common = dict(max_len=config.max_len, row_multiple=8 * max(num_shards, 1))
-    by_row = pack_padded_csr(
-        users, items, ratings, num_users, num_items, times=times, **common
+    """Pack COO interactions into both (bucketed) CSR orientations.
+
+    Every bucket's row count is padded to a multiple of
+    8 * num_shards * model_shards so each data shard is equal AND
+    lane-aligned, and (``factor_sharding="model"``) each data shard splits
+    evenly again over the model axis; with ``config.buckets == 1`` and the
+    default shard counts the layout (and therefore the math and the
+    seed-for-seed results) is exactly the historical single-block one.
+    """
+    users = np.asarray(users, dtype=np.int64)
+    items = np.asarray(items, dtype=np.int64)
+    ratings = np.asarray(ratings, dtype=np.float32)
+    rm = 8 * max(num_shards, 1) * max(model_shards, 1)
+    nb = max(int(config.buckets), 1)
+    plan_u = _plan_buckets(
+        np.bincount(users, minlength=num_users), config.max_len, nb, rm
     )
-    by_col = pack_padded_csr(
-        items, users, ratings, num_items, num_users, times=times, **common
+    plan_i = _plan_buckets(
+        np.bincount(items, minlength=num_items), config.max_len, nb, rm
+    )
+    by_row = _pack_side(
+        users, items, ratings, times, plan_u,
+        plan_i.total_slots, plan_i.slot_of, config.max_len, rm,
+    )
+    by_col = _pack_side(
+        items, users, ratings, times, plan_i,
+        plan_u.total_slots, plan_u.slot_of, config.max_len, rm,
     )
     return ALSData(by_row=by_row, by_col=by_col)
 
@@ -89,61 +339,137 @@ def _factor_precision(dtype):
     return "highest" if dtype == jnp.float32 else None
 
 
-def _half_step_explicit(indices, values, mask, factors, reg, rank, unroll):
-    """Solve one side's factors given the other side's (replicated) factors.
+def _gram_solve_explicit(gathered, values, n_obs, reg, rank, unroll, out_dtype):
+    """Gram + ALS-WR ridge + rhs + batched solve over pre-gathered factors.
 
-    factors carries a trailing zero row so padding gathers are in-bounds.
-    Mixed precision, ALX-style: factors may be bf16 (half the HBM traffic
-    for the gather and half the ICI traffic for the all-gather; bf16 inputs
-    are the MXU's native mode), while the Gram/rhs accumulate in f32 and
-    the normal-equation solve runs in f32; the solution is cast back to the
-    factor dtype on return. ``reg`` may be a traced scalar (the iteration
-    program is shared across regularization values -- see _build_iteration).
+    PADDING INVARIANT (what lets the mask array stay on the host): padding
+    slots' ``gathered`` rows are zero (their ``indices`` point at a zero
+    factor row -- the appended trailing row in replicated mode, any
+    out-of-shard index in model-sharded mode) and pack_padded_csr writes
+    zero ``values`` into padding slots. Every padding contribution to the
+    Gram and rhs therefore dies through the gathered zeros -- no ``[R, L]``
+    mask stream over HBM, no ``[R, L, K]`` mask multiply over the largest
+    intermediate. Only the per-row observation count ``n_obs`` (for ALS-WR
+    regularization) survives to the device, as an ``[R]`` vector.
+
+    Mixed precision, ALX-style: ``gathered`` may be bf16 (half the HBM
+    traffic for the gather and half the ICI traffic for the collective;
+    bf16 inputs are the MXU's native mode), while the Gram/rhs accumulate
+    in f32 and the normal-equation solve runs in f32; the solution is cast
+    back to ``out_dtype`` on return. ``reg`` may be a traced scalar (the
+    iteration program is shared across regularization values).
     """
-    gathered = factors[indices]                       # [R, L, K]
-    gathered = gathered * mask[..., None].astype(factors.dtype)
     gram = jnp.einsum(
         "rlk,rlj->rkj", gathered, gathered,
-        precision=_factor_precision(factors.dtype),
+        precision=_factor_precision(gathered.dtype),
         preferred_element_type=jnp.float32,
     )
     # MLlib-style weighted regularization: lambda * n_obs (ALS-WR); constant
     # lambda would also be defensible -- n_obs matches the reference template
-    n_obs = mask.sum(axis=1)
     ridge = reg * jnp.maximum(n_obs, 1.0)
     gram = gram + ridge[:, None, None] * jnp.eye(rank, dtype=gram.dtype)
     rhs = jnp.einsum(
-        "rlk,rl->rk", gathered, values * mask,
+        "rlk,rl->rk", gathered, values,
         precision="highest", preferred_element_type=jnp.float32,
     )
-    return batched_spd_solve(gram, rhs, unroll=unroll).astype(factors.dtype)
+    return batched_spd_solve(gram, rhs, unroll=unroll).astype(out_dtype)
 
 
-def _half_step_implicit(indices, values, mask, factors, reg, alpha, rank, unroll):
-    """Hu-Koren-Volinsky implicit step with the YtY trick.
+def _gram_solve_implicit(gathered, values, yty, reg, alpha, rank, unroll, out_dtype):
+    """Hu-Koren-Volinsky implicit tail with the YtY trick.
 
     G = YtY + sum_obs (c-1) y y^T + lam*I ; rhs = sum_obs c * y
-    Same mixed-precision contract as the explicit step: bf16-capable factor
-    storage, f32 Gram accumulation and solve.
+    Same mixed-precision contract and padding invariant as the explicit
+    tail: padding slots carry zero gathered rows and zero values, so every
+    padding term dies without a mask (``(1 + c-1) * y`` at a padding slot
+    multiplies the gathered zero row). Implicit mode uses constant lambda
+    (MLlib trainImplicit parity), so no n_obs.
     """
-    active = factors[:-1]  # drop the padding row from the global Gram
-    yty = jnp.einsum(
-        "nk,nj->kj", active, active,
-        precision=_factor_precision(factors.dtype),
-        preferred_element_type=jnp.float32,
-    )
-    gathered = factors[indices] * mask[..., None].astype(factors.dtype)
-    conf_minus_1 = alpha * values * mask
+    conf_minus_1 = alpha * values
     gram_fix = jnp.einsum(
         "rlk,rl,rlj->rkj", gathered, conf_minus_1, gathered,
         precision="highest", preferred_element_type=jnp.float32,
     )
     gram = yty[None] + gram_fix + reg * jnp.eye(rank, dtype=yty.dtype)
     rhs = jnp.einsum(
-        "rlk,rl->rk", gathered, (1.0 + conf_minus_1) * mask,
+        "rlk,rl->rk", gathered, (1.0 + conf_minus_1),
         precision="highest", preferred_element_type=jnp.float32,
     )
-    return batched_spd_solve(gram, rhs, unroll=unroll).astype(factors.dtype)
+    return batched_spd_solve(gram, rhs, unroll=unroll).astype(out_dtype)
+
+
+def _factors_yty(factors):
+    """f32 K x K Gram of a factor matrix (implicit mode's global term)."""
+    return jnp.einsum(
+        "nk,nj->kj", factors, factors,
+        precision=_factor_precision(factors.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _half_step_explicit(indices, values, n_obs, factors, reg, rank, unroll):
+    """Replicated-factor explicit half-step (gather + shared tail)."""
+    gathered = factors[indices]                       # [R, L, K]
+    return _gram_solve_explicit(
+        gathered, values, n_obs, reg, rank, unroll, factors.dtype
+    )
+
+
+def _half_step_implicit(indices, values, n_obs, factors, reg, alpha, rank, unroll):
+    """Replicated-factor implicit half-step.
+
+    ``n_obs`` is unused (constant lambda) but kept so both modes share one
+    block layout. Inter-bucket padding rows of ``factors`` are zero, so
+    they add nothing to the global Gram; the appended trailing zero row is
+    dropped from it explicitly.
+    """
+    del n_obs
+    yty = _factors_yty(factors[:-1])
+    gathered = factors[indices]
+    return _gram_solve_implicit(
+        gathered, values, yty, reg, alpha, rank, unroll, factors.dtype
+    )
+
+
+def _sharded_block_body(idx, values, n_obs, opp_local, reg, alpha,
+                        implicit, rank, unroll):
+    """Per-device half-step for one bucket with MODEL-SHARDED factors.
+
+    Runs inside shard_map over the full ("data", "model") mesh. Each
+    device holds opp_local = its model-axis shard of the opposite factor
+    matrix ([S/m, K], replicated across the data axis) and the full local
+    data-shard of the bucket's CSR rows. The ALX block exchange:
+
+    1. gather local hits only (out-of-shard indices -- including the
+       padding sentinel, which is out of EVERY shard -- contribute zeros);
+    2. psum_scatter over "model" completes the sum while handing each
+       device only its 1/m slice of the rows (half the traffic of a psum,
+       and the [rows, L, K] gathered intermediate shrinks by m);
+    3. each device solves its rows' normal equations -- compute scales
+       with the full d*m device count, not just d.
+
+    Output rows per device: the model-axis slice of the local data shard,
+    i.e. global layout P(("data", "model")).
+    """
+    m = jax.lax.axis_size("model")
+    mi = jax.lax.axis_index("model")
+    s_m = opp_local.shape[0]
+    loc = idx - mi * s_m
+    hit = (loc >= 0) & (loc < s_m)
+    g = opp_local[jnp.clip(loc, 0, s_m - 1)]
+    g = g * hit[..., None].astype(g.dtype)
+    g = jax.lax.psum_scatter(g, "model", scatter_dimension=0, tiled=True)
+    rows = idx.shape[0] // m
+    val_s = jax.lax.dynamic_slice_in_dim(values, mi * rows, rows, 0)
+    if implicit:
+        yty = jax.lax.psum(_factors_yty(opp_local), "model")
+        return _gram_solve_implicit(
+            g, val_s, yty, reg, alpha, rank, unroll, opp_local.dtype
+        )
+    n_s = jax.lax.dynamic_slice_in_dim(n_obs, mi * rows, rows, 0)
+    return _gram_solve_explicit(
+        g, val_s, n_s, reg, rank, unroll, opp_local.dtype
+    )
 
 
 def _append_zero_row(factors: jnp.ndarray) -> jnp.ndarray:
@@ -155,22 +481,44 @@ def _append_zero_row(factors: jnp.ndarray) -> jnp.ndarray:
 def make_iteration(mesh, config: ALSConfig):
     """The jitted full ALS iteration for (mesh, config) -- see _build_iteration.
 
-    The returned callable takes the CSR args + factor buffers followed by
-    the ``reg`` and ``alpha`` scalars (runtime values; the compiled program
-    is shared across them).
+    The returned callable takes the per-bucket CSR triples for both sides,
+    the factor buffers, then the ``reg`` and ``alpha`` scalars (runtime
+    values; the compiled program is shared across them). Bucket structure
+    is part of jit's input signature, not the cache key: the same callable
+    serves any bucket count (each distinct structure traces once).
     """
-    return _build_iteration(mesh, config.rank, config.implicit)
+    if config.factor_sharding not in ("replicated", "model"):
+        raise ValueError(
+            "ALSConfig.factor_sharding must be 'replicated' or 'model', "
+            f"got {config.factor_sharding!r}"
+        )
+    return _build_iteration(
+        mesh, config.rank, config.implicit, config.factor_sharding
+    )
 
 
 @cached_by_mesh(maxsize=32)
-def _build_iteration(mesh, rank: int, implicit: bool):
+def _build_iteration(mesh, rank: int, implicit: bool,
+                     factor_axis: str = "replicated"):
     """Build the jitted full ALS iteration (both half-steps fused).
 
-    CSR rows shard over the 'data' mesh axis; factor matrices live row-
-    sharded and are re-materialized replicated (+ zero pad row) INSIDE the
-    jit, so the all-gather that replaces MLlib's factor-block shuffle is an
-    on-device XLA collective, not a host round-trip. Factor buffers are
-    donated: each iteration updates in place instead of reallocating.
+    CSR rows (every bucket) shard over the 'data' mesh axis. Factor
+    placement follows ``factor_axis``:
+
+    - "replicated": factors live row-sharded over 'data' and are
+      re-materialized replicated (+ zero pad row) INSIDE the jit, so the
+      all-gather that replaces MLlib's factor-block shuffle is an
+      on-device XLA collective, not a host round-trip.
+    - "model": ALX block model-parallelism. Factors live row-sharded over
+      the 'model' axis; each half-step runs as a shard_map over the full
+      mesh doing local-hit gathers + a psum_scatter over 'model' (see
+      _sharded_block_body). No device ever materializes a whole factor
+      matrix: per-device factor memory is total_slots/m rows, which is
+      what lifts the catalog-size ceiling from one device's HBM to the
+      model axis's aggregate (docs/parallelism.md has the sizing math).
+
+    Factor buffers are donated: each iteration updates in place instead
+    of reallocating.
 
     ``reg``/``alpha`` are RUNTIME scalars, not baked constants: a
     ``pio eval`` grid over lambda/alpha reuses one compiled program per
@@ -179,8 +527,9 @@ def _build_iteration(mesh, rank: int, implicit: bool):
     covers repeated ``als_fit`` calls in one process (serving retrains,
     benchmarks).
     """
-    row = NamedSharding(mesh, PartitionSpec("data"))
-    rep = NamedSharding(mesh, PartitionSpec())
+    P = PartitionSpec
+    row = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
 
     # solve-path choice is per TARGET platform, not default backend: the
     # benchmark compiles a CPU mesh while a TPU backend is live (and vice
@@ -190,7 +539,42 @@ def _build_iteration(mesh, rank: int, implicit: bool):
     # reports platform "axon" for real TPU chips.
     unroll = mesh.devices.flat[0].platform != "cpu"
 
-    def iteration(u_idx, u_val, u_msk, i_idx, i_val, i_msk, users, items, reg, alpha):
+    if factor_axis == "model":
+        fsh = NamedSharding(mesh, P("model"))
+        body = functools.partial(
+            _sharded_block_body, implicit=implicit, rank=rank, unroll=unroll
+        )
+        smapped = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P("data", None), P("data", None), P("data"),
+                      P("model", None), P(), P()),
+            out_specs=P(("data", "model"), None),
+        )
+
+        def iteration(u_blocks, i_blocks, users, items, reg, alpha):
+            def solve_side(blocks, opp):
+                outs = [
+                    smapped(idx, val, n_obs, opp, reg, alpha)
+                    for idx, val, n_obs in blocks
+                ]
+                out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, 0)
+                # reshard P(("data","model")) -> P("model"): the all-gather
+                # over 'data' that readies this side for the next gather
+                return jax.lax.with_sharding_constraint(out, fsh)
+
+            users = solve_side(u_blocks, items)
+            items = solve_side(i_blocks, users)
+            return users, items
+
+        return jax.jit(
+            iteration,
+            in_shardings=(row, row, fsh, fsh, rep, rep),
+            out_shardings=(fsh, fsh),
+            donate_argnums=(2, 3),
+        )
+
+    def iteration(u_blocks, i_blocks, users, items, reg, alpha):
         if implicit:
             step = functools.partial(
                 _half_step_implicit, reg=reg, alpha=alpha, rank=rank, unroll=unroll
@@ -199,17 +583,23 @@ def _build_iteration(mesh, rank: int, implicit: bool):
             step = functools.partial(
                 _half_step_explicit, reg=reg, rank=rank, unroll=unroll
             )
+
+        def solve_side(blocks, opp_full):
+            outs = [step(idx, val, n_obs, opp_full) for idx, val, n_obs in blocks]
+            out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+            return jax.lax.with_sharding_constraint(out, row)
+
         items_full = jax.lax.with_sharding_constraint(_append_zero_row(items), rep)
-        users = step(u_idx, u_val, u_msk, items_full)
+        users = solve_side(u_blocks, items_full)
         users_full = jax.lax.with_sharding_constraint(_append_zero_row(users), rep)
-        items = step(i_idx, i_val, i_msk, users_full)
+        items = solve_side(i_blocks, users_full)
         return users, items
 
     return jax.jit(
         iteration,
-        in_shardings=(row,) * 8 + (rep, rep),
+        in_shardings=(row, row, row, row, rep, rep),
         out_shardings=(row, row),
-        donate_argnums=(6, 7),
+        donate_argnums=(2, 3),
     )
 
 
@@ -242,6 +632,17 @@ class ALSModel:
         return (self.item_factors @ v) / np.maximum(norms, 1e-12)
 
 
+def device_put_blocks(side: BucketedCSR, put) -> tuple:
+    """``put`` each bucket block as its device triple (indices, values,
+    n_obs). The ``[R, L]`` mask never crosses the host link: the padding
+    invariant (see _half_step_explicit) reduces it to the per-row
+    observation count."""
+    return tuple(
+        (put(b.indices), put(b.values), put(b.mask.sum(axis=1)))
+        for b in side.blocks
+    )
+
+
 def als_fit(
     data: ALSData,
     config: ALSConfig,
@@ -255,14 +656,14 @@ def als_fit(
 
     ``callback(iteration, user_factors, item_factors)`` runs every
     ``callback_interval`` iterations (skipping the final one, whose result
-    als_fit returns anyway) with HOST numpy copies (safe to retain -- the
-    checkpointing hook; the on-device buffers are donated between
-    iterations and must not escape). The interval lives HERE so
-    non-callback iterations never pay the device sync + host copy that
-    materializing the factors costs. ``init``/``start_iteration`` resume
-    from checkpointed factors: the remaining iterations run, which is exact
-    for ALS (each iteration depends only on the previous factors).
-    ``mesh`` defaults to a 1-device local mesh.
+    als_fit returns anyway) with HOST numpy copies in ORIGINAL entity
+    order (safe to retain -- the checkpointing hook; the on-device buffers
+    are donated between iterations and must not escape). The interval
+    lives HERE so non-callback iterations never pay the device sync + host
+    copy that materializing the factors costs. ``init``/``start_iteration``
+    resume from checkpointed factors (original order): the remaining
+    iterations run, which is exact for ALS (each iteration depends only on
+    the previous factors). ``mesh`` defaults to a 1-device local mesh.
     """
     from predictionio_tpu.parallel.mesh import local_mesh
 
@@ -278,48 +679,79 @@ def als_fit(
     dtype = jnp.dtype(config.dtype)
     scale = 1.0 / np.sqrt(config.rank)
 
-    def init_factors(num_real: int, num_padded: int, seed: int) -> np.ndarray:
-        # draw exactly the real rows from a dedicated stream, then zero-pad:
-        # init is invariant to shard-count-dependent padding, and phantom
-        # rows stay invisible to the implicit-mode global Gram
+    def init_factors(side: BucketedCSR, seed: int) -> np.ndarray:
+        # draw exactly the real rows from a dedicated stream IN ORIGINAL
+        # entity order, then scatter into slots: init is invariant to the
+        # bucket plan and to shard-count-dependent padding, and phantom
+        # rows stay zero (invisible to the implicit-mode global Gram)
         rng = np.random.default_rng(seed)
-        real = rng.normal(size=(num_real, config.rank)) * scale
-        return np.pad(real, ((0, num_padded - num_real), (0, 0)))
+        real = rng.normal(size=(side.num_rows, config.rank)) * scale
+        out = np.zeros((side.total_slots, config.rank))
+        out[side.slot_of] = real
+        return out
+
+    def scatter_init(side: BucketedCSR, host: np.ndarray) -> np.ndarray:
+        out = np.zeros((side.total_slots, host.shape[1]), dtype=np.float64)
+        out[side.slot_of] = np.asarray(host)[: side.num_rows]
+        return out
 
     if init is not None:
-        users0 = np.pad(
-            np.asarray(init[0]),
-            ((0, data.by_row.indices.shape[0] - init[0].shape[0]), (0, 0)),
-        )
-        items0 = np.pad(
-            np.asarray(init[1]),
-            ((0, data.by_col.indices.shape[0] - init[1].shape[0]), (0, 0)),
-        )
+        users0 = scatter_init(data.by_row, init[0])
+        items0 = scatter_init(data.by_col, init[1])
     else:
-        users0 = init_factors(
-            data.by_row.num_rows, data.by_row.indices.shape[0], config.seed
-        )
-        items0 = init_factors(
-            data.by_col.num_rows, data.by_col.indices.shape[0], config.seed + 1
-        )
+        users0 = init_factors(data.by_row, config.seed)
+        items0 = init_factors(data.by_col, config.seed + 1)
 
     from predictionio_tpu.parallel.mesh import fetch_global as fetch
     from predictionio_tpu.parallel.mesh import put_global
 
     row = NamedSharding(mesh, PartitionSpec("data"))
-    # multi-host: every process loads the same event store; put_global
-    # feeds each exactly its addressable row shards
+    # default path: every process loads the same event store; put_global
+    # feeds each exactly its addressable row shards. Sides built by the
+    # SHARDED reader (global_rows set) carry only this process's rows and
+    # assemble via make_array_from_process_local_data -- no host ever held
+    # the global edge set (SURVEY 2.6 DP row: host-side sharded reader).
     put_row = lambda a: put_global(a, row)
 
-    u_idx = put_row(data.by_row.indices)
-    u_val = put_row(data.by_row.values)
-    u_msk = put_row(data.by_row.mask)
-    i_idx = put_row(data.by_col.indices)
-    i_val = put_row(data.by_col.values)
-    i_msk = put_row(data.by_col.mask)
+    def put_side(side: BucketedCSR):
+        if side.global_rows is None:
+            return device_put_blocks(side, put_row)
+        return tuple(
+            (
+                jax.make_array_from_process_local_data(
+                    row, b.indices, (rows, b.indices.shape[1])
+                ),
+                jax.make_array_from_process_local_data(
+                    row, b.values, (rows, b.values.shape[1])
+                ),
+                jax.make_array_from_process_local_data(
+                    row, b.mask.sum(axis=1), (rows,)
+                ),
+            )
+            for b, rows in zip(side.blocks, side.global_rows)
+        )
 
-    user_factors = put_row(users0.astype(dtype))
-    item_factors = put_row(items0.astype(dtype))
+    u_blocks = put_side(data.by_row)
+    i_blocks = put_side(data.by_col)
+
+    if config.factor_sharding == "model":
+        m = mesh.shape["model"]
+        d = mesh.shape["data"]
+        for side, name in ((data.by_row, "user"), (data.by_col, "item")):
+            if side.total_slots % m or any(
+                b.indices.shape[0] % (d * m) for b in side.blocks
+            ):
+                raise ValueError(
+                    f"factor_sharding='model' needs every {name} bucket's "
+                    f"padded rows divisible by data*model = {d}*{m}; build "
+                    f"the data with build_als_data(..., num_shards={d}, "
+                    f"model_shards={m})"
+                )
+        fsh = NamedSharding(mesh, PartitionSpec("model"))
+    else:
+        fsh = row
+    user_factors = put_global(users0.astype(dtype), fsh)
+    item_factors = put_global(items0.astype(dtype), fsh)
 
     iteration = make_iteration(mesh, config)
     # globally-replicated scalars: a process-local jnp scalar cannot feed a
@@ -330,10 +762,14 @@ def als_fit(
     reg = put_global(np.float32(config.reg), rep)
     alpha = put_global(np.float32(config.alpha), rep)
 
+    def to_host(factors, side: BucketedCSR) -> np.ndarray:
+        # f32 on the host regardless of the on-device factor dtype:
+        # checkpoints and serving stay dtype-stable across bf16 runs
+        return fetch(factors)[side.slot_of].astype(np.float32)
+
     for it in range(start_iteration, config.iterations):
         user_factors, item_factors = iteration(
-            u_idx, u_val, u_msk, i_idx, i_val, i_msk, user_factors, item_factors,
-            reg, alpha,
+            u_blocks, i_blocks, user_factors, item_factors, reg, alpha
         )
         if (
             callback is not None
@@ -342,17 +778,16 @@ def als_fit(
         ):
             # host copies: the device buffers are donated into the next
             # iteration; handing them out would raise 'Array has been
-            # deleted' one iteration later, far from the cause. f32 on the
-            # host regardless of the on-device factor dtype: checkpoints
-            # and serving stay dtype-stable across bf16 runs
+            # deleted' one iteration later, far from the cause
             callback(
                 it,
-                fetch(user_factors)[: data.by_row.num_rows].astype(np.float32),
-                fetch(item_factors)[: data.by_col.num_rows].astype(np.float32),
+                to_host(user_factors, data.by_row),
+                to_host(item_factors, data.by_col),
             )
 
     # serving model is always f32 host-side (numpy top-k math on bf16 via
     # ml_dtypes is slow and lossy; the dtype knob is a TRAINING layout)
-    user_np = fetch(user_factors)[: data.by_row.num_rows].astype(np.float32)
-    item_np = fetch(item_factors)[: data.by_col.num_rows].astype(np.float32)
-    return ALSModel(user_factors=user_np, item_factors=item_np)
+    return ALSModel(
+        user_factors=to_host(user_factors, data.by_row),
+        item_factors=to_host(item_factors, data.by_col),
+    )

@@ -1,0 +1,298 @@
+"""Sharded host-side event reader for the ALS/cooccurrence data path.
+
+SURVEY.md section 2.6 names the TPU-native equivalent of Spark's
+partitioned event scan a "host-side sharded event reader". The default
+``build_als_data`` path has every process load and pack the FULL edge set
+(each reads the same event store) -- correct, but at ALX-scale catalogs it
+is the first thing to OOM a host. This module is the scaling path:
+
+1. every process streams the SAME deterministically-ordered COO chunk
+   stream (bounded memory per chunk -- e.g. the SQL backends'
+   ``iter_interaction_chunks`` keyset-stable scan);
+2. pass 1 accumulates per-entity interaction counts only (O(entities));
+3. both sides' bucket plans are computed from the counts -- deterministic,
+   so every process derives the SAME layout without communicating;
+4. pass 2 RETAINS only the edges whose row lands in this process's
+   data-axis shard of each side (~edges/processes + skew, the
+   memory-scaling claim the tests instrument via ``retained_edges``);
+5. the local rows pack into per-bucket blocks (forced to the global
+   padded length) and ``als_fit`` assembles them with
+   ``jax.make_array_from_process_local_data`` -- no host ever
+   materializes a global array of edge extent.
+
+The reference analogue is HBase's ``TableInputFormat`` splits feeding
+Spark executors (SURVEY section 3.1): partition-local reads, global
+layout by plan, not by shuffle.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator
+
+import numpy as np
+
+from predictionio_tpu.parallel.als import (
+    ALSConfig,
+    ALSData,
+    BucketedCSR,
+    _BucketPlan,
+    _plan_buckets,
+)
+from predictionio_tpu.ops.ragged import pack_padded_csr
+
+#: a chunk is (users, items, values, times-or-None), integer-encoded
+Chunk = tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray | None]
+#: zero-arg callable producing a fresh pass over the stream
+ChunkSource = Callable[[], Iterable[Chunk]]
+
+
+class IncrementalEncoder:
+    """First-appearance string->int vocabulary, stable across passes.
+
+    Every process consumes the same ordered stream, so ids agree across
+    processes AND across the two passes (setdefault is idempotent).
+    """
+
+    def __init__(self) -> None:
+        self.vocab: dict[str, int] = {}
+
+    def encode(self, values) -> np.ndarray:
+        v = self.vocab
+        return np.fromiter(
+            (v.setdefault(x, len(v)) for x in values),
+            dtype=np.int64,
+            count=len(values),
+        )
+
+    @property
+    def ids(self) -> list[str]:
+        return list(self.vocab)
+
+
+def store_coo_chunks(
+    l_events,
+    app_id: int,
+    channel_id: int | None = None,
+    event_names: list[str] | None = None,
+    rating_key: str = "rating",
+    chunk_rows: int = 262_144,
+    default_value: float = 1.0,
+) -> tuple[ChunkSource, IncrementalEncoder, IncrementalEncoder]:
+    """COO chunk source over a backend's columnar chunked scan.
+
+    Returns ``(source, user_encoder, item_encoder)``; the encoders fill in
+    stream order during the first pass and are the id<->index mapping the
+    serving model needs. Rows with no numeric rating carry
+    ``default_value`` (implicit-feedback events like "view"/"buy").
+    Requires the backend to expose ``iter_interaction_chunks`` (the SQL
+    family does); others can stream through any adapter that yields the
+    same five columns.
+    """
+    users_enc, items_enc = IncrementalEncoder(), IncrementalEncoder()
+
+    def source() -> Iterator[Chunk]:
+        for ents, tgts, _names, times_iso, ratings in l_events.iter_interaction_chunks(
+            app_id=app_id,
+            channel_id=channel_id,
+            event_names=event_names,
+            rating_key=rating_key,
+            chunk_rows=chunk_rows,
+        ):
+            keep = [i for i, t in enumerate(tgts) if t is not None]
+            uu = users_enc.encode([ents[i] for i in keep])
+            ii = items_enc.encode([tgts[i] for i in keep])
+            vals = np.fromiter(
+                (
+                    default_value if ratings[i] is None else float(ratings[i])
+                    for i in keep
+                ),
+                dtype=np.float32,
+                count=len(keep),
+            )
+            tt = np.fromiter(
+                (
+                    _dt.datetime.fromisoformat(times_iso[i]).timestamp()
+                    for i in keep
+                ),
+                dtype=np.float64,
+                count=len(keep),
+            )
+            yield uu, ii, vals, tt
+
+    return source, users_enc, items_enc
+
+
+def _local_row_range(sharding, nrows: int) -> tuple[int, int]:
+    """This process's contiguous [lo, hi) slice of a row-sharded dim."""
+    spans = {
+        (sl[0].start or 0, nrows if sl[0].stop is None else sl[0].stop)
+        for sl in sharding.addressable_devices_indices_map((nrows,)).values()
+    }  # a set: devices along replicated axes (model) share the same slice
+    lo = min(s for s, _ in spans)
+    hi = max(e for _, e in spans)
+    if hi - lo != sum(e - s for s, e in spans):
+        raise ValueError(
+            "this process's shards of the data axis are not contiguous; "
+            "the sharded reader requires a process-contiguous device order "
+            "(build_mesh's default)"
+        )
+    return lo, hi
+
+
+@dataclass
+class _SideAccumulator:
+    """Pass-2 retention state for one orientation."""
+
+    plan: _BucketPlan
+    ranges: list[tuple[int, int]]  # local [lo, hi) per bucket, global slots
+    rows: list[list[np.ndarray]]
+    cols: list[list[np.ndarray]]
+    vals: list[list[np.ndarray]]
+    times: list[list[np.ndarray]]
+    retained: int = 0
+
+    def take(self, row_slots, col_slots, vals, times) -> None:
+        for b, (lo, hi) in enumerate(self.ranges):
+            off = self.plan.offsets[b]
+            sel = (row_slots >= off + lo) & (row_slots < off + hi)
+            if not sel.any():
+                continue
+            self.rows[b].append(row_slots[sel] - off - lo)
+            self.cols[b].append(col_slots[sel])
+            self.vals[b].append(vals[sel])
+            if times is not None:
+                self.times[b].append(times[sel])
+            self.retained += int(sel.sum())
+
+
+def _grow_bincount(cnt: np.ndarray, ids: np.ndarray) -> np.ndarray:
+    """Accumulate a bincount whose extent grows with the observed ids."""
+    if ids.size == 0:
+        return cnt
+    add = np.bincount(ids, minlength=cnt.size)
+    if add.size > cnt.size:
+        cnt = np.pad(cnt, (0, add.size - cnt.size))
+        return cnt + add
+    cnt[: add.size] += add
+    return cnt
+
+
+def build_als_data_sharded(
+    chunks: ChunkSource,
+    num_users: int | None,
+    num_items: int | None,
+    config: ALSConfig,
+    mesh,
+    model_shards: int = 1,
+) -> ALSData:
+    """Two-pass, retention-bounded ALSData for (multi-process) ``mesh``.
+
+    Equivalent layout to ``build_als_data`` (same bucket plans, same slot
+    maps, same padded lengths) but each process keeps only the edges its
+    data-axis shard needs, per side. Feed the result straight to
+    ``als_fit``; the ``global_rows`` marker routes device placement
+    through make_array_from_process_local_data.
+
+    ``num_users``/``num_items`` may be None: the store-backed path cannot
+    know the distinct-entity counts before the first scan (the encoders
+    fill in during it), so pass 1 grows the count arrays with the stream
+    and the entity universe becomes whatever the stream contained. When
+    given, they are lower-bounded by the stream (ids beyond them grow the
+    arrays rather than crashing the bincount).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    d = mesh.shape["data"]
+    rm = 8 * d * max(model_shards, 1)
+    nb = max(int(config.buckets), 1)
+    row_sharding = NamedSharding(mesh, PartitionSpec("data"))
+
+    # -- pass 1: per-entity counts (O(entities) memory) --------------------
+    cnt_u = np.zeros(num_users or 0, dtype=np.int64)
+    cnt_i = np.zeros(num_items or 0, dtype=np.int64)
+    for uu, ii, _vv, _tt in chunks():
+        cnt_u = _grow_bincount(cnt_u, uu)
+        cnt_i = _grow_bincount(cnt_i, ii)
+    plan_u = _plan_buckets(cnt_u, config.max_len, nb, rm)
+    plan_i = _plan_buckets(cnt_i, config.max_len, nb, rm)
+
+    def side_acc(plan: _BucketPlan) -> _SideAccumulator:
+        ranges = [
+            _local_row_range(row_sharding, rows) for rows in plan.padded_rows
+        ]
+        k = len(plan.sizes)
+        return _SideAccumulator(
+            plan=plan,
+            ranges=ranges,
+            rows=[[] for _ in range(k)],
+            cols=[[] for _ in range(k)],
+            vals=[[] for _ in range(k)],
+            times=[[] for _ in range(k)],
+        )
+
+    acc_u = side_acc(plan_u)
+    acc_i = side_acc(plan_i)
+
+    # -- pass 2: retain this process's rows only ---------------------------
+    for uu, ii, vv, tt in chunks():
+        u_slots = plan_u.slot_of[uu]
+        i_slots = plan_i.slot_of[ii]
+        acc_u.take(u_slots, i_slots, vv, tt)
+        acc_i.take(i_slots, u_slots, vv, tt)
+
+    def pack_side(acc: _SideAccumulator, opp_plan: _BucketPlan) -> BucketedCSR:
+        blocks = []
+        for b, (lo, hi) in enumerate(acc.ranges):
+            cat = lambda parts, dt: (
+                np.concatenate(parts) if parts else np.empty(0, dt)
+            )
+            times_b = cat(acc.times[b], np.float64) if acc.times[b] else None
+            blocks.append(
+                pack_padded_csr(
+                    cat(acc.rows[b], np.int64),
+                    cat(acc.cols[b], np.int64),
+                    cat(acc.vals[b], np.float32),
+                    num_rows=hi - lo,
+                    num_cols=opp_plan.total_slots,
+                    max_len=config.max_len,
+                    times=times_b,
+                    row_multiple=8,
+                    pad_len=acc.plan.lengths[b],
+                )
+            )
+        return BucketedCSR(
+            blocks=tuple(blocks),
+            slot_of=acc.plan.slot_of,
+            num_rows=int(acc.plan.slot_of.shape[0]),
+            total_slots=acc.plan.total_slots,
+            global_rows=tuple(acc.plan.padded_rows),
+            retained_edges=acc.retained,
+        )
+
+    return ALSData(
+        by_row=pack_side(acc_u, plan_i), by_col=pack_side(acc_i, plan_u)
+    )
+
+
+def array_coo_chunks(
+    users: np.ndarray,
+    items: np.ndarray,
+    values: np.ndarray,
+    times: np.ndarray | None = None,
+    chunk_rows: int = 262_144,
+) -> ChunkSource:
+    """ChunkSource over in-memory COO arrays (tests / already-loaded data)."""
+
+    def source() -> Iterator[Chunk]:
+        for lo in range(0, len(users), chunk_rows):
+            hi = lo + chunk_rows
+            yield (
+                np.asarray(users[lo:hi], np.int64),
+                np.asarray(items[lo:hi], np.int64),
+                np.asarray(values[lo:hi], np.float32),
+                None if times is None else np.asarray(times[lo:hi], np.float64),
+            )
+
+    return source

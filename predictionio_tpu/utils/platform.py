@@ -32,11 +32,17 @@ def ensure_backend(platform: str | None = None, fallback: bool = False) -> str:
 
     want = platform or os.environ.get("PIO_PLATFORM")
     if want:
+        prior = jax.config.jax_platforms
         jax.config.update("jax_platforms", want)
         try:
             return jax.devices()[0].platform
         except RuntimeError as exc:
             if not fallback:
+                # restore the pre-call selection: a caller that catches
+                # this to report a friendly error must not find the
+                # process's JAX backend config left pointing at the
+                # broken name
+                jax.config.update("jax_platforms", prior)
                 raise RuntimeError(
                     f"explicitly requested JAX platform {want!r} (via "
                     f"{'platform arg' if platform else 'PIO_PLATFORM'}) is "
