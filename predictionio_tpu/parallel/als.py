@@ -310,6 +310,16 @@ def build_als_data(
     users = np.asarray(users, dtype=np.int64)
     items = np.asarray(items, dtype=np.int64)
     ratings = np.asarray(ratings, dtype=np.float32)
+    # ids beyond the declared catalog are an encoder/count mismatch; fail
+    # HERE (np.bincount would silently grow the entity universe and hand
+    # back a wrong-shaped factor model far from the cause)
+    for ids, declared, what in ((users, num_users, "user"),
+                                (items, num_items, "item")):
+        if ids.size and int(ids.max()) >= declared:
+            raise ValueError(
+                f"{what} id {int(ids.max())} out of range for "
+                f"num_{what}s={declared}"
+            )
     rm = 8 * max(num_shards, 1) * max(model_shards, 1)
     nb = max(int(config.buckets), 1)
     plan_u = _plan_buckets(
