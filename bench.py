@@ -431,6 +431,9 @@ def _run_child(
     env["PIO_BENCH_CHILD_SCALE"] = str(scale)
     if mode == "cpu" or (mode == "secondary" and not tpu_platform):
         env["JAX_PLATFORMS"] = "cpu"
+        # an operator-exported platform knob must not leak TPU shape
+        # selection into a CPU child
+        env.pop("PIO_BENCH_TPU_PLATFORM", None)
     else:
         env.pop("JAX_PLATFORMS", None)
         if tpu_platform:

@@ -1,0 +1,126 @@
+"""Concurrent-load latency benchmark for a deployed Query Server.
+
+The reference's serving SLO story is N stateless query servers behind a
+load balancer (SURVEY.md section 5.3); the <5 ms p50 target (BASELINE)
+is only meaningful under concurrent keep-alive load, not a single
+sequential client. This tool drives ``POST /queries.json`` from N
+threads, each with its own persistent HTTP connection, and reports the
+latency distribution plus aggregate throughput:
+
+    python -m predictionio_tpu.tools.serving_bench \
+        --url http://127.0.0.1:8000 --clients 8 --requests 400 \
+        --query '{"user": "u1", "num": 4}'
+
+Prints one JSON line; also importable (``run_load``) for tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+
+
+def _percentile(sorted_ms: list[float], q: float) -> float | None:
+    if not sorted_ms:
+        return None  # JSON null: NaN is not valid RFC 8259 output
+    idx = min(int(q * len(sorted_ms)), len(sorted_ms) - 1)
+    return round(sorted_ms[idx], 3)
+
+
+def run_load(
+    url: str,
+    query: dict | str,
+    clients: int = 8,
+    requests: int = 400,
+    timeout: float = 30.0,
+) -> dict:
+    """N keep-alive clients, ``requests`` total POSTs; latency stats in ms.
+
+    Every client thread owns one persistent connection (the reference
+    SDKs' connection-pool behavior); failures are counted, not raised,
+    so a mid-run hiccup yields a truthful report instead of a stack
+    trace.
+    """
+    parsed = urllib.parse.urlsplit(url)
+    body = query if isinstance(query, str) else json.dumps(query)
+    payload = body.encode()
+    clients = min(clients, requests) or 1
+    base, extra = divmod(requests, clients)
+    # distribute the remainder so exactly ``requests`` POSTs are sent
+    counts = [base + (1 if k < extra else 0) for k in range(clients)]
+    lat_ms: list[list[float]] = [[] for _ in range(clients)]
+    failures = [0] * clients
+    start_gate = threading.Event()
+
+    def client(k: int) -> None:
+        conn_cls = (
+            http.client.HTTPSConnection
+            if parsed.scheme == "https"
+            else http.client.HTTPConnection
+        )
+        conn = conn_cls(parsed.hostname, parsed.port, timeout=timeout)
+        start_gate.wait()
+        for _ in range(counts[k]):
+            t0 = time.perf_counter()
+            try:
+                conn.request(
+                    "POST", "/queries.json", payload,
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    failures[k] += 1
+                    continue
+            except OSError:
+                failures[k] += 1
+                conn.close()
+                continue
+            lat_ms[k].append((time.perf_counter() - t0) * 1000.0)
+        conn.close()
+
+    threads = [
+        threading.Thread(target=client, args=(k,), daemon=True)
+        for k in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    start_gate.set()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+
+    flat = sorted(x for per in lat_ms for x in per)
+    return {
+        "clients": clients,
+        "requests_ok": len(flat),
+        "failures": sum(failures),
+        "p50_ms": _percentile(flat, 0.50),
+        "p90_ms": _percentile(flat, 0.90),
+        "p99_ms": _percentile(flat, 0.99),
+        "qps": round(len(flat) / wall_s, 1) if wall_s > 0 else 0.0,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default="http://127.0.0.1:8000")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=400)
+    ap.add_argument("--query", default='{"user": "u1", "num": 4}')
+    args = ap.parse_args(argv)
+    print(
+        json.dumps(
+            run_load(args.url, args.query, args.clients, args.requests)
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
