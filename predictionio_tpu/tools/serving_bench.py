@@ -76,7 +76,9 @@ def run_load(
                 if resp.status != 200:
                     failures[k] += 1
                     continue
-            except OSError:
+            except (OSError, http.client.HTTPException):
+                # HTTPException covers malformed responses (a garbled LB
+                # status line) -- a dead thread would under-report silently
                 failures[k] += 1
                 conn.close()
                 continue
