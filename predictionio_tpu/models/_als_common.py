@@ -30,16 +30,23 @@ def prepare_als_data(
     num_items: int,
     times: np.ndarray,
 ):
-    """Pack COO interactions into padded CSR blocks sized for ctx's mesh."""
+    """Pack COO interactions into padded CSR blocks sized for ctx's mesh.
+
+    Rows pad to multiples of 8 * data_axis * model_axis: a model axis of
+    1 (the default mesh) reproduces the historical layout, and a model
+    axis > 1 makes the blocks ready for the ALX factor-sharded mode the
+    fit side auto-selects on such meshes (resolve_factor_sharding).
+    """
     config = ALSConfig(
         max_len=params.get_or("maxEventsPerUser", None),
         # length-bucketed packing: engine.json "buckets" (default 1 keeps
         # the single-block layout; the ML-20M bench uses 4)
         buckets=params.get_or("buckets", 1),
     )
-    num_shards = 1
+    num_shards, model_shards = 1, 1
     try:
         num_shards = ctx.mesh.shape.get("data", 1)
+        model_shards = ctx.mesh.shape.get("model", 1)
     except Exception:
         pass  # no devices available (pure-host tests)
     return build_als_data(
@@ -51,6 +58,49 @@ def prepare_als_data(
         config,
         times=times,
         num_shards=num_shards,
+        model_shards=model_shards,
+    )
+
+
+#: packing knobs the PREPARATOR consumes; a natural mistake is putting
+#: them in the algorithm block (the reference template had no preparator
+#: params), where they would be silently ignored
+PACKING_PARAM_KEYS = ("maxEventsPerUser", "buckets")
+
+
+def warn_misplaced_packing_params(algo_params, template: str) -> None:
+    misplaced = [
+        k for k in PACKING_PARAM_KEYS
+        if algo_params.get_or(k, None) is not None
+    ]
+    if misplaced:
+        logger.warning(
+            "%s: %s configure the PREPARATOR (put them under "
+            '"preparator": {"params": {...}} in engine.json); they are '
+            "ignored in the algorithm block",
+            template, ", ".join(misplaced),
+        )
+
+
+def resolve_factor_sharding(config: ALSConfig, mesh) -> ALSConfig:
+    """Resolve ``factor_sharding="auto"`` against the actual mesh.
+
+    On a pure-ALS template a model axis > 1 has exactly one use -- ALX
+    factor sharding -- so "auto" (the template default) selects it
+    whenever ``pio.mesh_shape`` configures such an axis, and plain data
+    parallelism otherwise. Explicit "replicated"/"model" pass through to
+    the library untouched (als_fit validates them).
+    """
+    import dataclasses
+
+    if config.factor_sharding != "auto":
+        return config
+    try:
+        model = mesh.shape.get("model", 1) if mesh is not None else 1
+    except Exception:
+        model = 1
+    return dataclasses.replace(
+        config, factor_sharding="model" if model > 1 else "replicated"
     )
 
 
@@ -151,6 +201,7 @@ def fit_with_checkpoint(
 
     ``interval`` <= 0 disables checkpointing entirely.
     """
+    config = resolve_factor_sharding(config, mesh)
     checkpoint = ctx.checkpoint_manager(name) if interval > 0 else None
     init, start_iteration, callback = None, 0, None
     if checkpoint is not None:

@@ -42,6 +42,7 @@ from predictionio_tpu.models._als_common import (
     partition_user_queries,
     prepare_als_data,
     topk_item_scores,
+    warn_misplaced_packing_params,
 )
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
 
@@ -191,10 +192,13 @@ class ECommAlgorithm(TPUAlgorithm):
             implicit=p.get_or("implicitPrefs", True),
             seed=p.get_or("seed", 0),
             dtype=p.get_or("factorDtype", "float32"),
+            # "auto": ALX model-sharded factors on a model-axis mesh
+            factor_sharding=p.get_or("factorSharding", "auto"),
         )
 
     def train(self, ctx, prepared) -> ECommerceModel:
         data, als_data = prepared
+        warn_misplaced_packing_params(self.params, "ecommerce")
         model = fit_with_checkpoint(
             ctx,
             als_data,

@@ -29,6 +29,7 @@ from predictionio_tpu.models._als_common import (
     partition_user_queries,
     prepare_als_data,
     topk_item_scores,
+    warn_misplaced_packing_params,
 )
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
 
@@ -174,10 +175,14 @@ class ALSAlgorithm(TPUAlgorithm):
             # "bfloat16" halves factor HBM/ICI traffic on TPU (ALX-style
             # mixed precision: f32 Grams + solve, bf16 storage/gathers)
             dtype=p.get_or("factorDtype", "float32"),
+            # "auto": ALX model-sharded factors whenever pio.mesh_shape
+            # configures a model axis > 1 (resolve_factor_sharding)
+            factor_sharding=p.get_or("factorSharding", "auto"),
         )
 
     def train(self, ctx, prepared) -> RecommendationModel:
         ratings_data, als_data = prepared
+        warn_misplaced_packing_params(self.params, "recommendation")
         model = fit_with_checkpoint(
             ctx,
             als_data,

@@ -70,6 +70,39 @@ class TestRecommendationEngine:
         scores = [s["score"] for s in result["itemScores"]]
         assert scores == sorted(scores, reverse=True)
 
+    def test_model_axis_mesh_trains_alx_sharded(self, movie_app):
+        """pio.mesh_shape [-1, 2] (a model axis) auto-selects the ALX
+        factor-sharded mode through the whole template path -- packing
+        pads for data x model, fit resolves "auto" -> "model" -- and the
+        recommendations still rank the clique correctly."""
+        engine = engine_factory()
+        ctx = RuntimeContext({"pio.mesh_shape": [-1, 2]})
+        assert ctx.mesh.shape["model"] == 2
+        params = make_params(rank=8, numIterations=10, **{"lambda": 0.05},
+                             seed=3)
+        models = engine.train(ctx, params)
+        algo = engine._algorithms(params)[0]
+        result = algo.predict(models[0], {"user": "g0u0", "num": 2})
+        items = [s["item"] for s in result["itemScores"]]
+        assert len(items) == 2 and all(i.startswith("s") for i in items), items
+        # explicit opt-out must actually resolve to "replicated" on the
+        # same mesh (not just avoid crashing), and "auto" to "model"
+        from predictionio_tpu.models._als_common import resolve_factor_sharding
+        from predictionio_tpu.parallel.als import ALSConfig
+
+        resolved_auto = resolve_factor_sharding(
+            ALSConfig(factor_sharding="auto"), ctx.mesh
+        )
+        assert resolved_auto.factor_sharding == "model"
+        resolved_rep = resolve_factor_sharding(
+            ALSConfig(factor_sharding="replicated"), ctx.mesh
+        )
+        assert resolved_rep.factor_sharding == "replicated"
+        params_rep = make_params(rank=8, numIterations=4, **{"lambda": 0.05},
+                                 seed=3, factorSharding="replicated")
+        models_rep = engine.train(ctx, params_rep)
+        assert models_rep[0].als.user_factors.shape[1] == 8
+
     def test_unseen_only_filters_rated(self, movie_app):
         engine = engine_factory()
         ctx = RuntimeContext()
