@@ -38,6 +38,35 @@ class TestPackPaddedCSR:
         assert p.indices.shape[0] == 8
         assert p.num_rows == 5
 
+    def test_pad_len_forces_block_shape(self):
+        """Multi-process packs force the GLOBAL padded length even when the
+        local maximum is shorter -- every process must agree on shapes."""
+        p = pack_padded_csr(
+            np.array([0, 0]), np.array([1, 2]), np.ones(2, np.float32),
+            num_rows=2, num_cols=5, pad_len=24,
+        )
+        assert p.indices.shape[1] == 24
+        # empty local shard: same forced length
+        empty = pack_padded_csr(
+            np.array([]), np.array([]), np.array([], np.float32),
+            num_rows=2, num_cols=5, pad_len=24,
+        )
+        assert empty.indices.shape[1] == 24 and empty.mask.sum() == 0
+        # pad_len shorter than the longest row without truncation: loud
+        import pytest
+
+        with pytest.raises(ValueError, match="pad_len"):
+            pack_padded_csr(
+                np.zeros(9, int), np.arange(9), np.ones(9, np.float32),
+                num_rows=1, num_cols=9, pad_len=8,
+            )
+        # ... but fine when max_len truncation was requested
+        t = pack_padded_csr(
+            np.zeros(9, int), np.arange(9), np.ones(9, np.float32),
+            num_rows=1, num_cols=9, pad_len=8, max_len=8,
+        )
+        assert t.truncated == 1 and t.indices.shape[1] == 8
+
     def test_empty(self):
         p = pack_padded_csr(np.array([]), np.array([]), np.array([]), 4, 7)
         assert p.mask.sum() == 0
