@@ -53,8 +53,6 @@ class TestPackPaddedCSR:
         )
         assert empty.indices.shape[1] == 24 and empty.mask.sum() == 0
         # pad_len shorter than the longest row without truncation: loud
-        import pytest
-
         with pytest.raises(ValueError, match="pad_len"):
             pack_padded_csr(
                 np.zeros(9, int), np.arange(9), np.ones(9, np.float32),
