@@ -165,37 +165,77 @@ def _run_cooc(
     row_totals=None,
     col_totals=None,
 ):
-    """Pad, upload (once per distinct CSR), run the cached program, fetch."""
+    """Pad, upload (once per distinct CSR), run the cached program, fetch.
+
+    Accepts ``ShardedPaddedCSR`` inputs (parallel.reader): each process
+    then contributes only its local user-row slice via
+    make_array_from_process_local_data instead of uploading a full host
+    copy -- the retention-bounded multi-host path.
+    """
+    import jax as _jax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from predictionio_tpu.parallel.reader import ShardedPaddedCSR, cooc_global_rows
+
     data_size = int(mesh.shape["data"])
-    # base row math on the PHYSICAL (row_multiple-padded) CSR rows, not
-    # num_rows: pack_padded_csr rounds rows up, and a target below the
-    # physical count would make _pad_rows_sentinel's pad width negative
-    phys_rows = max(primary.indices.shape[0], other.indices.shape[0])
-    per_device = -(-phys_rows // data_size)
-    chunk = max(1, min(chunk, per_device))
-    # every device scans the same number of fixed-size chunks: pad the user
-    # universe so rows = data * chunks_per_device * chunk
-    chunks_per_device = -(-per_device // chunk)
-    rows = data_size * chunks_per_device * chunk
-    idx_p, msk_p = _pad_rows_sentinel(primary, rows)
+    sharded = isinstance(primary, ShardedPaddedCSR)
+    if sharded != isinstance(other, ShardedPaddedCSR):
+        raise ValueError(
+            "mixing a sharded-reader CSR with a full host CSR is not "
+            "supported: build both sides sharded (or neither)"
+        )
+    if sharded:
+        rows = primary.global_rows
+        expect = cooc_global_rows(primary.num_rows, mesh, chunk)
+        if rows != expect or other.global_rows != rows:
+            raise ValueError(
+                f"sharded CSR was built for a different mesh/chunk layout "
+                f"(rows {rows}/{other.global_rows}, this call expects "
+                f"{expect}); rebuild with build_cooc_csr_sharded(mesh=..., "
+                f"chunk={chunk})"
+            )
+        per_device = rows // data_size
+        chunk = max(1, min(chunk, per_device))
+    else:
+        # base row math on the PHYSICAL (row_multiple-padded) CSR rows, not
+        # num_rows: pack_padded_csr rounds rows up, and a target below the
+        # physical count would make _pad_rows_sentinel's pad width negative
+        phys_rows = max(primary.indices.shape[0], other.indices.shape[0])
+        per_device = -(-phys_rows // data_size)
+        chunk = max(1, min(chunk, per_device))
+        # every device scans the same number of fixed-size chunks: pad the
+        # user universe so rows = data * chunks_per_device * chunk
+        chunks_per_device = -(-per_device // chunk)
+        rows = data_size * chunks_per_device * chunk
     fn = _build_cooc_fn(
         mesh, chunk, primary.num_cols, other.num_cols,
-        primary.indices.shape[1], other.indices.shape[1],
+        primary.max_len, other.max_len,
         top_k, llr, drop_diagonal, float(total),
     )
     from predictionio_tpu.parallel.mesh import fetch_global, put_global
 
     sharding = NamedSharding(mesh, PartitionSpec("data"))
     rep = NamedSharding(mesh, PartitionSpec())
-    put = lambda a: put_global(a, sharding)
-    g_idx_p, g_msk_p = put(idx_p), put(msk_p)
-    if other is primary:  # self-cooccurrence: one upload serves both sides
-        g_idx_o, g_msk_o = g_idx_p, g_msk_p
+    if sharded:
+        put_local = lambda a, L: _jax.make_array_from_process_local_data(
+            sharding, a, (rows, L)
+        )
+        g_idx_p = put_local(primary.local.indices, primary.max_len)
+        g_msk_p = put_local(primary.local.mask, primary.max_len)
+        if other is primary:
+            g_idx_o, g_msk_o = g_idx_p, g_msk_p
+        else:
+            g_idx_o = put_local(other.local.indices, other.max_len)
+            g_msk_o = put_local(other.local.mask, other.max_len)
     else:
-        idx_o, msk_o = _pad_rows_sentinel(other, rows)
-        g_idx_o, g_msk_o = put(idx_o), put(msk_o)
+        put = lambda a: put_global(a, sharding)
+        idx_p, msk_p = _pad_rows_sentinel(primary, rows)
+        g_idx_p, g_msk_p = put(idx_p), put(msk_p)
+        if other is primary:  # self-cooccurrence: one upload serves both
+            g_idx_o, g_msk_o = g_idx_p, g_msk_p
+        else:
+            idx_o, msk_o = _pad_rows_sentinel(other, rows)
+            g_idx_o, g_msk_o = put(idx_o), put(msk_o)
     dummy = np.zeros(1, np.float32)
     row_t = jax.device_put(
         np.asarray(row_totals if row_totals is not None else dummy, np.float32),

@@ -40,7 +40,7 @@ from predictionio_tpu.parallel.als import (
     _BucketPlan,
     _plan_buckets,
 )
-from predictionio_tpu.ops.ragged import pack_padded_csr
+from predictionio_tpu.ops.ragged import pack_padded_csr, round_up
 
 #: a chunk is (users, items, values, times-or-None), integer-encoded
 Chunk = tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray | None]
@@ -274,6 +274,149 @@ def build_als_data_sharded(
     return ALSData(
         by_row=pack_side(acc_u, plan_i), by_col=pack_side(acc_i, plan_u)
     )
+
+
+@dataclass
+class ShardedPaddedCSR:
+    """Process-local slice of a row-sharded PaddedCSR (+ global extent).
+
+    The cooccurrence analogue of the bucketed ALS reader output: ``local``
+    holds ONLY this process's user rows ``[row_lo, row_hi)`` of a global
+    ``[global_rows, L]`` layout (plain user-id row order -- cooccurrence
+    needs no length bucketing), and the ops layer assembles the device
+    array via make_array_from_process_local_data. Duck-types the
+    ``num_rows``/``num_cols`` surface the cooccurrence entry points check.
+    """
+
+    local: PaddedCSR
+    global_rows: int
+    row_lo: int
+    row_hi: int
+    num_rows: int   # real (global) user rows
+    num_cols: int
+    retained_edges: int
+
+    @property
+    def max_len(self) -> int:
+        return self.local.indices.shape[1]
+
+
+def cooc_global_rows(num_users: int, mesh, chunk: int) -> int:
+    """The global padded row count the sharded cooccurrence layout uses.
+
+    Mirrors ``ops.cooccurrence._run_cooc``'s chunking: every device scans
+    the same number of fixed-size ``chunk`` row blocks, so rows =
+    data * ceil(per_device / chunk_eff) * chunk_eff. Builder and runner
+    must agree, so this is THE shared definition.
+    """
+    data_size = int(mesh.shape["data"])
+    phys = max(round_up(num_users, 8), 8)
+    per_device = -(-phys // data_size)
+    chunk_eff = max(1, min(chunk, per_device))
+    return data_size * (-(-per_device // chunk_eff)) * chunk_eff
+
+
+def build_cooc_csr_sharded(
+    chunks: ChunkSource,
+    num_users: int | None,
+    num_items: int | None,
+    mesh,
+    max_len: int | None = None,
+    chunk: int = 4096,
+) -> ShardedPaddedCSR:
+    """Retention-bounded user-rows CSR for the cooccurrence/UR pipeline.
+
+    Two passes like ``build_als_data_sharded``: counts first (so every
+    process derives the same padded length), then retain only the edges
+    whose user row falls in this process's data-axis shard. ``chunk``
+    must match the ``chunk`` later passed to the cooccurrence entry
+    points (it shapes the global row padding; the runner validates).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    cnt_u = np.zeros(num_users or 0, dtype=np.int64)
+    n_items = num_items or 0
+    for uu, ii, _vv, _tt in chunks():
+        cnt_u = _grow_bincount(cnt_u, uu)
+        if ii.size:
+            n_items = max(n_items, int(ii.max()) + 1)
+    n_users = cnt_u.size
+    if n_users == 0:
+        raise ValueError(
+            "no interactions in the stream and no entity counts given -- "
+            "check appName/eventNames (an empty event store cannot build "
+            "a cooccurrence model)"
+        )
+    capped = int(min(cnt_u.max(), max_len)) if max_len else int(cnt_u.max())
+    pad_len = max(round_up(capped, 8), 8)
+
+    rows = cooc_global_rows(n_users, mesh, chunk)
+    row_sharding = NamedSharding(mesh, PartitionSpec("data"))
+    lo, hi = _local_row_range(row_sharding, rows)
+
+    keep_r: list[np.ndarray] = []
+    keep_c: list[np.ndarray] = []
+    keep_v: list[np.ndarray] = []
+    keep_t: list[np.ndarray] = []
+    retained = 0
+    for uu, ii, vv, tt in chunks():
+        sel = (uu >= lo) & (uu < hi)
+        if not sel.any():
+            continue
+        keep_r.append(uu[sel] - lo)
+        keep_c.append(ii[sel])
+        keep_v.append(vv[sel])
+        if tt is not None:
+            keep_t.append(tt[sel])
+        retained += int(sel.sum())
+
+    cat = lambda parts, dt: np.concatenate(parts) if parts else np.empty(0, dt)
+    local = pack_padded_csr(
+        cat(keep_r, np.int64),
+        cat(keep_c, np.int64),
+        cat(keep_v, np.float32),
+        num_rows=hi - lo,
+        num_cols=n_items,
+        max_len=max_len,
+        times=cat(keep_t, np.float64) if keep_t else None,
+        # the local block must match the shard span EXACTLY: rounding it
+        # up would hand make_array_from_process_local_data a buffer
+        # larger than this process's addressable rows (the cooc layout's
+        # chunk-based spans are not 8-aligned, and the plain-XLA cooc
+        # path has no leading-dim alignment requirement)
+        row_multiple=1,
+        pad_len=pad_len,
+    )
+    return ShardedPaddedCSR(
+        local=local,
+        global_rows=rows,
+        row_lo=lo,
+        row_hi=hi,
+        num_rows=n_users,
+        num_cols=n_items,
+        retained_edges=retained,
+    )
+
+
+def distinct_user_counts_sharded(s: ShardedPaddedCSR) -> np.ndarray:
+    """Global per-item distinct-user counts from process-local rows.
+
+    User rows partition across processes, so per-item distinct counts are
+    additive: local counts + a cross-process sum reproduce
+    ``ops.cooccurrence.distinct_user_counts`` on the global CSR exactly.
+    """
+    import jax
+
+    from predictionio_tpu.ops.cooccurrence import distinct_user_counts
+
+    local = distinct_user_counts(s.local)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(local)
+        ).reshape(jax.process_count(), -1).sum(axis=0).astype(np.float32)
+    return local
 
 
 def array_coo_chunks(

@@ -171,6 +171,187 @@ class TestStoreChunkScan:
         assert users_enc.vocab == vocab_after_pass1
 
 
+class TestShardedCooccurrence:
+    def test_matches_full_path_single_process(self):
+        """Sharded-reader CSR through the cooccurrence + LLR + top-k
+        pipeline must reproduce the full-host path bit-for-bit (same
+        layout, same chunking), including the distinct-user LLR totals."""
+        from predictionio_tpu.ops.cooccurrence import (
+            cooccurrence_indicators,
+            distinct_user_counts,
+        )
+        from predictionio_tpu.ops.ragged import pack_padded_csr
+        from predictionio_tpu.parallel.reader import (
+            build_cooc_csr_sharded,
+            distinct_user_counts_sharded,
+        )
+
+        rng = np.random.default_rng(3)
+        n_u, n_i, n_e = 300, 40, 4000
+        uu = rng.integers(0, n_u, n_e)
+        ii = rng.integers(0, n_i, n_e)
+        vv = np.ones(n_e, np.float32)
+        mesh = local_mesh(8, 1)
+        full = pack_padded_csr(uu, ii, vv, n_u, n_i)
+        counts = distinct_user_counts(full)
+        idx_f, val_f = cooccurrence_indicators(
+            full, top_k=10, llr_row_totals=counts, llr_col_totals=counts,
+            total=n_u, mesh=mesh, chunk=64,
+        )
+        s = build_cooc_csr_sharded(
+            array_coo_chunks(uu, ii, vv, chunk_rows=700), n_u, n_i, mesh,
+            chunk=64,
+        )
+        counts_s = distinct_user_counts_sharded(s)
+        np.testing.assert_array_equal(counts, counts_s)
+        idx_s, val_s = cooccurrence_indicators(
+            s, top_k=10, llr_row_totals=counts_s, llr_col_totals=counts_s,
+            total=n_u, mesh=mesh, chunk=64,
+        )
+        np.testing.assert_array_equal(idx_f, idx_s)
+        np.testing.assert_allclose(val_f, val_s, atol=1e-4)
+
+    def test_unaligned_chunk_spans(self):
+        """Regression: the cooc layout's chunk-based spans need not be
+        8-aligned (rows=108 over 4 devices -> 27-row spans); the local
+        pack must match the shard span exactly rather than rounding up,
+        or make_array_from_process_local_data rejects the buffer."""
+        from predictionio_tpu.ops.cooccurrence import (
+            cooccurrence,
+            distinct_user_counts,
+        )
+        from predictionio_tpu.ops.ragged import pack_padded_csr
+        from predictionio_tpu.parallel.reader import build_cooc_csr_sharded
+
+        rng = np.random.default_rng(5)
+        uu = rng.integers(0, 100, 1200)
+        ii = rng.integers(0, 12, 1200)
+        vv = np.ones(1200, np.float32)
+        mesh = local_mesh(4, 1)
+        s = build_cooc_csr_sharded(
+            array_coo_chunks(uu, ii, vv), 100, 12, mesh, chunk=3
+        )
+        assert s.global_rows == 108 and s.local.indices.shape[0] == 108
+        got = cooccurrence(s, mesh=mesh, chunk=3)
+        want = cooccurrence(pack_padded_csr(uu, ii, vv, 100, 12))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_empty_stream_rejected(self):
+        from predictionio_tpu.parallel.reader import build_cooc_csr_sharded
+
+        with pytest.raises(ValueError, match="empty event store"):
+            build_cooc_csr_sharded(
+                array_coo_chunks(
+                    np.array([]), np.array([]), np.array([], np.float32)
+                ),
+                None, None, local_mesh(4, 1),
+            )
+
+    def test_layout_mismatch_rejected(self):
+        from predictionio_tpu.ops.cooccurrence import cooccurrence
+        from predictionio_tpu.parallel.reader import build_cooc_csr_sharded
+
+        rng = np.random.default_rng(3)
+        uu = rng.integers(0, 100, 500)
+        ii = rng.integers(0, 10, 500)
+        vv = np.ones(500, np.float32)
+        mesh = local_mesh(8, 1)
+        s = build_cooc_csr_sharded(
+            array_coo_chunks(uu, ii, vv), 100, 10, mesh, chunk=8
+        )
+        with pytest.raises(ValueError, match="rebuild"):
+            cooccurrence(s, mesh=mesh, chunk=4096)  # different chunk layout
+
+
+_COOC_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from predictionio_tpu.parallel.distributed import init_distributed, build_mesh
+    from predictionio_tpu.parallel.reader import (
+        array_coo_chunks, build_cooc_csr_sharded, distinct_user_counts_sharded)
+    from predictionio_tpu.ops.cooccurrence import cooccurrence_indicators
+    import numpy as np
+
+    pid = int(sys.argv[1])
+    assert init_distributed({coord!r}, 2, pid)
+    mesh = build_mesh([8, 1], ("data", "model"))
+    rng = np.random.default_rng(23)
+    n_u, n_i, n_e = 400, 30, 5000
+    uu = rng.integers(0, n_u, n_e)
+    ii = rng.integers(0, n_i, n_e)
+    vv = np.ones(n_e, np.float32)
+    s = build_cooc_csr_sharded(
+        array_coo_chunks(uu, ii, vv, chunk_rows=900), n_u, n_i, mesh, chunk=32)
+    assert 0.3 * n_e < s.retained_edges < 0.7 * n_e, s.retained_edges
+    counts = distinct_user_counts_sharded(s)
+    idx, vals = cooccurrence_indicators(
+        s, top_k=8, llr_row_totals=counts, llr_col_totals=counts,
+        total=n_u, mesh=mesh, chunk=32)
+    if pid == 0:
+        np.savez({out!r}, idx=idx, vals=vals, counts=counts,
+                 retained=np.array([s.retained_edges]))
+    print("OK", flush=True)
+    """
+)
+
+
+def test_two_process_sharded_cooccurrence(tmp_path):
+    """Cooccurrence across two OS processes through the sharded reader:
+    each retains ~half the edges, the psum crosses the process boundary,
+    and the LLR indicators match a single-process full-host build."""
+    out = tmp_path / "cooc.npz"
+    script = tmp_path / "cooc_reader_worker.py"
+    script.write_text(
+        _COOC_WORKER.format(
+            repo=_repo_root(), coord=f"127.0.0.1:{_free_port()}", out=str(out)
+        )
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o
+        assert "OK" in o
+
+    from predictionio_tpu.ops.cooccurrence import (
+        cooccurrence_indicators,
+        distinct_user_counts,
+    )
+    from predictionio_tpu.ops.ragged import pack_padded_csr
+
+    rng = np.random.default_rng(23)
+    n_u, n_i, n_e = 400, 30, 5000
+    uu = rng.integers(0, n_u, n_e)
+    ii = rng.integers(0, n_i, n_e)
+    vv = np.ones(n_e, np.float32)
+    full = pack_padded_csr(uu, ii, vv, n_u, n_i)
+    counts = distinct_user_counts(full)
+    idx_f, val_f = cooccurrence_indicators(
+        full, top_k=8, llr_row_totals=counts, llr_col_totals=counts,
+        total=n_u, mesh=local_mesh(8, 1), chunk=32,
+    )
+    got = np.load(out)
+    assert got["retained"][0] < 0.7 * n_e
+    np.testing.assert_array_equal(got["counts"], counts)
+    np.testing.assert_array_equal(got["idx"], idx_f)
+    np.testing.assert_allclose(got["vals"], val_f, atol=1e-4)
+
+
 _WORKER = textwrap.dedent(
     """
     import os, sys
