@@ -46,6 +46,9 @@ class RatingsData(SanityCheck):
     times: np.ndarray       # float64 epoch seconds
     user_ids: list[str]
     item_ids: list[str]
+    #: carried for serving-time live event-store reads (seenFilter "live")
+    app_name: str = ""
+    event_names: list[str] = None
 
     def sanity_check(self) -> None:
         if self.users.size == 0:
@@ -87,6 +90,8 @@ class RecommendationDataSource(DataSource):
             times=ds.event_times[valid],
             user_ids=ds.entity_id_vocab,
             item_ids=ds.target_entity_id_vocab,
+            app_name=self.params.appName,
+            event_names=list(event_names),
         )
 
     def read_training(self, ctx) -> RatingsData:
@@ -108,6 +113,8 @@ class RecommendationDataSource(DataSource):
                 times=data.times[~test_mask],
                 user_ids=data.user_ids,
                 item_ids=data.item_ids,
+                app_name=data.app_name,
+                event_names=data.event_names,
             )
             qa = {}
             for u, i in zip(data.users[test_mask], data.items[test_mask]):
@@ -153,6 +160,53 @@ class RecommendationModel:
     item_ids: list[str]
     item_index: dict[str, int]
     seen: dict[int, set[int]]  # user -> rated item indices (for filtering)
+    #: "model": the seen map above (O(edges) host memory, zero-latency).
+    #: "live": per-query event-store read (the e-commerce template's
+    #: pattern) -- the serving model stays O(entities), which is what a
+    #: sharded-reader-scale catalog needs. Old pickled blobs predate
+    #: these fields; readers go through getattr with defaults.
+    seen_mode: str = "model"
+    app_name: str = ""
+    event_names: list[str] = None
+
+
+def _seen_indices(model: "RecommendationModel", query, user_idx: int) -> set[int]:
+    """The user's already-interacted item indices for the unseenOnly filter.
+
+    "model" mode reads the trained-in seen map. "live" mode queries the
+    event store per request (the e-commerce template's pattern): the
+    serving model stays O(entities) -- required at sharded-reader catalog
+    scale, where no single host can hold an O(edges) map -- and newly
+    ingested interactions filter immediately without a retrain. A store
+    error degrades to "nothing seen" with a log line (serving must not
+    500 because a backend blinked).
+    """
+    if getattr(model, "seen_mode", "model") != "live":
+        return model.seen.get(user_idx, set())
+    if not getattr(model, "app_name", ""):
+        return set()  # nothing to resolve; don't pay a failing store
+        # lookup + warning per request (ecommerce template pattern)
+    from predictionio_tpu.data.store import LEventStore
+
+    try:
+        events = LEventStore.find(
+            getattr(model, "app_name", ""),
+            entity_type="user",
+            entity_id=str(query.get("user")),
+            event_names=getattr(model, "event_names", None) or None,
+            target_entity_type="item",
+        )
+        return {
+            model.item_index[e.target_entity_id]
+            for e in events
+            if e.target_entity_id in model.item_index
+        }
+    except Exception:
+        logger.warning(
+            "live seen-filter lookup failed; serving unfiltered",
+            exc_info=True,
+        )
+        return set()
 
 
 class ALSAlgorithm(TPUAlgorithm):
@@ -183,6 +237,11 @@ class ALSAlgorithm(TPUAlgorithm):
     def train(self, ctx, prepared) -> RecommendationModel:
         ratings_data, als_data = prepared
         warn_misplaced_packing_params(self.params, "recommendation")
+        seen_mode = self.params.get_or("seenFilter", "model")
+        if seen_mode not in ("model", "live"):
+            raise ValueError(
+                f"seenFilter must be 'model' or 'live', got {seen_mode!r}"
+            )
         model = fit_with_checkpoint(
             ctx,
             als_data,
@@ -192,13 +251,20 @@ class ALSAlgorithm(TPUAlgorithm):
             item_ids=ratings_data.item_ids,
             interval=self.params.get_or("checkpointInterval", 5),
         )
-        seen = build_seen(ratings_data.users, ratings_data.items)
+        # "live" keeps the serving model O(entities): no O(edges) seen map
+        seen = (
+            build_seen(ratings_data.users, ratings_data.items)
+            if seen_mode == "model" else {}
+        )
         return RecommendationModel(
             als=model,
             user_index={uid: idx for idx, uid in enumerate(ratings_data.user_ids)},
             item_ids=ratings_data.item_ids,
             item_index={iid: idx for idx, iid in enumerate(ratings_data.item_ids)},
             seen=seen,
+            seen_mode=seen_mode,
+            app_name=ratings_data.app_name,
+            event_names=ratings_data.event_names,
         )
 
     def warm_up(self, model: RecommendationModel) -> None:
@@ -245,7 +311,7 @@ class ALSAlgorithm(TPUAlgorithm):
             if b in model.item_index
         }
         if query.get("unseenOnly", True):
-            exclude |= model.seen.get(user_idx, set())
+            exclude |= _seen_indices(model, query, user_idx)
         for idx in exclude:
             scores[idx] = -np.inf
         return topk_item_scores(model.item_ids, scores, num)

@@ -172,7 +172,6 @@ def _run_cooc(
     make_array_from_process_local_data instead of uploading a full host
     copy -- the retention-bounded multi-host path.
     """
-    import jax as _jax
     from jax.sharding import NamedSharding, PartitionSpec
 
     from predictionio_tpu.parallel.reader import ShardedPaddedCSR, cooc_global_rows
@@ -217,7 +216,7 @@ def _run_cooc(
     sharding = NamedSharding(mesh, PartitionSpec("data"))
     rep = NamedSharding(mesh, PartitionSpec())
     if sharded:
-        put_local = lambda a, L: _jax.make_array_from_process_local_data(
+        put_local = lambda a, L: jax.make_array_from_process_local_data(
             sharding, a, (rows, L)
         )
         g_idx_p = put_local(primary.local.indices, primary.max_len)

@@ -103,6 +103,45 @@ class TestRecommendationEngine:
         models_rep = engine.train(ctx, params_rep)
         assert models_rep[0].als.user_factors.shape[1] == 8
 
+    def test_live_seen_filter(self, movie_app, storage_env):
+        """seenFilter "live": the model carries NO O(edges) seen map; the
+        unseenOnly filter reads the event store per query (so fresh
+        interactions filter without retrain), and must agree with the
+        trained-in map for existing events."""
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.store import PEventStore
+
+        engine = engine_factory()
+        ctx = RuntimeContext()
+        params = make_params(rank=8, numIterations=6, **{"lambda": 0.05},
+                             seenFilter="live")
+        models = engine.train(ctx, params)
+        model = models[0]
+        assert model.seen == {} and model.seen_mode == "live"
+        algo = engine._algorithms(params)[0]
+        rated = {
+            e.target_entity_id
+            for e in PEventStore.find("MovieApp", entity_id="g0u0")
+        }
+        result = algo.predict(model, {"user": "g0u0", "num": 12})
+        assert not ({s["item"] for s in result["itemScores"]} & rated)
+        # a NEW event filters immediately, no retrain
+        fresh = next(i for i in model.item_ids
+                     if i not in rated and i.startswith("s"))
+        le = storage_env.get_l_events()
+        le.insert(
+            Event(event="rate", entity_type="user", entity_id="g0u0",
+                  target_entity_type="item", target_entity_id=fresh,
+                  properties=DataMap({"rating": 5.0})),
+            app_id=movie_app,
+        )
+        after = algo.predict(model, {"user": "g0u0", "num": 12})
+        assert fresh not in {s["item"] for s in after["itemScores"]}
+        # opt-out still serves everything
+        raw = algo.predict(model, {"user": "g0u0", "num": 12,
+                                   "unseenOnly": False})
+        assert {s["item"] for s in raw["itemScores"]} & rated
+
     def test_unseen_only_filters_rated(self, movie_app):
         engine = engine_factory()
         ctx = RuntimeContext()
