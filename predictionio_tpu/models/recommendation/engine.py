@@ -49,6 +49,14 @@ class RatingsData(SanityCheck):
     #: carried for serving-time live event-store reads (seenFilter "live")
     app_name: str = ""
     event_names: list[str] = None
+    #: True when built by the streaming sharded reader: edge arrays are
+    #: empty (only the vocabularies are materialized)
+    streamed: bool = False
+    channel_name: str = None   # non-default channel the data came from
+    #: True for read_eval's fold copies: live seen-filtering is downgraded
+    #: to the trained-in map there (the held-out events still exist in the
+    #: store, and a live read would exclude every 'actual' item)
+    eval_fold: bool = False
 
     def sanity_check(self) -> None:
         if self.users.size == 0:
@@ -65,12 +73,50 @@ class RatingsData(SanityCheck):
         return len(self.item_ids)
 
 
+@dataclass
+class StreamingRatings(SanityCheck):
+    """Lazy handle for the sharded-reader training path (no arrays).
+
+    ``"reader": "streaming"`` makes the DataSource return THIS instead of
+    materialized COO arrays: the preparator then streams the store's
+    chunked columnar scan and each process retains only its data-shard's
+    edges (parallel.reader) -- `pio train` on a multi-host pod never
+    materializes the global edge set on any host. Requires
+    ``seenFilter: "live"`` (an O(edges) trained-in seen map would defeat
+    the point).
+    """
+
+    app_name: str
+    app_id: int
+    channel_id: int | None
+    channel_name: str | None
+    event_names: list[str]
+    rating_key: str
+    chunk_rows: int = 262_144
+
+    def sanity_check(self) -> None:
+        from predictionio_tpu.data import storage
+
+        probe = list(
+            storage.get_l_events().find(
+                app_id=self.app_id, channel_id=self.channel_id,
+                event_names=self.event_names, limit=1,
+            )
+        )
+        if not probe:
+            raise ValueError(
+                "no rating events found -- check appName and eventNames"
+            )
+
+
 class RecommendationDataSource(DataSource):
     """Reads rating-like events into COO form.
 
     Params: ``appName`` (required), ``eventNames`` (default ["rate", "buy"]),
     ``ratingKey`` (property holding the rating; "buy"-style events without it
-    score 1.0), ``evalK``/``evalFolds`` for read_eval.
+    score 1.0), ``evalK``/``evalFolds`` for read_eval; ``"reader":
+    "streaming"`` switches read_training to the retention-bounded sharded
+    reader (see StreamingRatings).
     """
 
     def _read(self) -> RatingsData:
@@ -94,7 +140,22 @@ class RecommendationDataSource(DataSource):
             event_names=list(event_names),
         )
 
-    def read_training(self, ctx) -> RatingsData:
+    def read_training(self, ctx):
+        if self.params.get_or("reader", "materialized") == "streaming":
+            from predictionio_tpu.data.store import resolve_app_channel
+
+            app_id, channel_id = resolve_app_channel(
+                self.params.appName, self.params.get_or("channelName", None)
+            )
+            return StreamingRatings(
+                app_name=self.params.appName,
+                app_id=app_id,
+                channel_id=channel_id,
+                channel_name=self.params.get_or("channelName", None),
+                event_names=self.params.get_or("eventNames", ["rate", "buy"]),
+                rating_key=self.params.get_or("ratingKey", "rating"),
+                chunk_rows=self.params.get_or("chunkRows", 262_144),
+            )
         return self._read()
 
     def read_eval(self, ctx):
@@ -115,6 +176,7 @@ class RecommendationDataSource(DataSource):
                 item_ids=data.item_ids,
                 app_name=data.app_name,
                 event_names=data.event_names,
+                eval_fold=True,
             )
             qa = {}
             for u, i in zip(data.users[test_mask], data.items[test_mask]):
@@ -131,9 +193,17 @@ class RecommendationDataSource(DataSource):
 
 
 class RecommendationPreparator(Preparator):
-    """Packs COO ratings into padded CSR blocks sized for the mesh."""
+    """Packs COO ratings into padded CSR blocks sized for the mesh.
 
-    def prepare(self, ctx, training_data: RatingsData):
+    Preparator params: ``buckets`` (length-bucketed packing),
+    ``maxEventsPerUser`` (history cap). A StreamingRatings handle (the
+    DataSource's ``"reader": "streaming"`` mode) routes through the
+    retention-bounded sharded reader instead of full host arrays.
+    """
+
+    def prepare(self, ctx, training_data):
+        if isinstance(training_data, StreamingRatings):
+            return self._prepare_streaming(ctx, training_data)
         als_data = prepare_als_data(
             ctx,
             self.params,
@@ -145,6 +215,46 @@ class RecommendationPreparator(Preparator):
             times=training_data.times,
         )
         return training_data, als_data
+
+    def _prepare_streaming(self, ctx, src: StreamingRatings):
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.parallel.reader import (
+            build_als_data_sharded,
+            store_coo_chunks,
+        )
+
+        config = ALSConfig(
+            max_len=self.params.get_or("maxEventsPerUser", None),
+            buckets=self.params.get_or("buckets", 1),
+        )
+        mesh = ctx.mesh
+        source, users_enc, items_enc = store_coo_chunks(
+            storage.get_l_events(),
+            src.app_id,
+            channel_id=src.channel_id,
+            event_names=src.event_names,
+            rating_key=src.rating_key,
+            chunk_rows=src.chunk_rows,
+        )
+        als_data = build_als_data_sharded(
+            source, None, None, config, mesh,
+            model_shards=mesh.shape.get("model", 1),
+        )
+        # vocabularies materialized by the scan; edge arrays stay empty --
+        # the whole point of the streaming path
+        ratings_like = RatingsData(
+            users=np.empty(0, np.int64),
+            items=np.empty(0, np.int64),
+            ratings=np.empty(0, np.float32),
+            times=np.empty(0, np.float64),
+            user_ids=users_enc.ids,
+            item_ids=items_enc.ids,
+            app_name=src.app_name,
+            event_names=src.event_names,
+            streamed=True,
+            channel_name=src.channel_name,
+        )
+        return ratings_like, als_data
 
 
 @dataclass
@@ -168,6 +278,7 @@ class RecommendationModel:
     seen_mode: str = "model"
     app_name: str = ""
     event_names: list[str] = None
+    channel_name: str = None
 
 
 def _seen_indices(model: "RecommendationModel", query, user_idx: int) -> set[int]:
@@ -193,6 +304,7 @@ def _seen_indices(model: "RecommendationModel", query, user_idx: int) -> set[int
             getattr(model, "app_name", ""),
             entity_type="user",
             entity_id=str(query.get("user")),
+            channel_name=getattr(model, "channel_name", None),
             event_names=getattr(model, "event_names", None) or None,
             target_entity_type="item",
         )
@@ -237,11 +349,30 @@ class ALSAlgorithm(TPUAlgorithm):
     def train(self, ctx, prepared) -> RecommendationModel:
         ratings_data, als_data = prepared
         warn_misplaced_packing_params(self.params, "recommendation")
-        seen_mode = self.params.get_or("seenFilter", "model")
+        streamed = getattr(ratings_data, "streamed", False)
+        seen_mode = self.params.get_or(
+            "seenFilter", "live" if streamed else "model"
+        )
         if seen_mode not in ("model", "live"):
             raise ValueError(
                 f"seenFilter must be 'model' or 'live', got {seen_mode!r}"
             )
+        if streamed and seen_mode == "model":
+            raise ValueError(
+                "the streaming reader materializes no edges, so there is "
+                'no O(edges) seen map to train in; use "seenFilter": "live"'
+            )
+        if seen_mode == "live" and getattr(ratings_data, "eval_fold", False):
+            # a live read sees the WHOLE store -- including the held-out
+            # test events -- and would score every 'actual' item -inf,
+            # collapsing fold metrics to zero. Evaluation folds carry
+            # their train-edge arrays, so the trained-in map is both
+            # correct and available.
+            logger.info(
+                "seenFilter 'live' downgraded to 'model' for this "
+                "evaluation fold (a live read would exclude held-out items)"
+            )
+            seen_mode = "model"
         model = fit_with_checkpoint(
             ctx,
             als_data,
@@ -287,12 +418,26 @@ class ALSAlgorithm(TPUAlgorithm):
         raise predict()'s normal error (the batch-predict workflow converts
         those to per-row error records)."""
         user_rows, fallback = partition_user_queries(model.user_index, queries)
+        # live seen-filter: one store lookup per DISTINCT user for the
+        # whole bulk run, not one per row (the scoring itself is still a
+        # single matmul; batch-heavy deployments preferring zero lookups
+        # should train with seenFilter "model")
+        seen_memo: dict[int, set[int]] = {}
+
+        def seen_for(q, user_idx):
+            if user_idx not in seen_memo:
+                seen_memo[user_idx] = _seen_indices(model, q, user_idx)
+            return seen_memo[user_idx]
+
         out = batch_score_known_users(
             model.als,
             user_rows,
             lambda scores, qid, q, user_idx: (
                 qid,
-                self._topk_response(model, scores, q, int(q.get("num", 10)), user_idx),
+                self._topk_response(
+                    model, scores, q, int(q.get("num", 10)), user_idx,
+                    seen=seen_for(q, user_idx),
+                ),
             ),
         )
         out.extend((qid, self.predict(model, q)) for qid, q in fallback)
@@ -300,10 +445,12 @@ class ALSAlgorithm(TPUAlgorithm):
 
     @staticmethod
     def _topk_response(
-        model: RecommendationModel, scores: np.ndarray, query, num: int, user_idx: int
+        model: RecommendationModel, scores: np.ndarray, query, num: int,
+        user_idx: int, seen: set | None = None,
     ) -> dict:
         """Shared filter + top-k over one user's item scores (predict and
-        the vectorized batch path must rank identically)."""
+        the vectorized batch path must rank identically). ``seen`` lets
+        the batch path pass a memoized lookup; None resolves per call."""
         # blackList always applies; the seen-items filter is opt-out
         exclude = {
             model.item_index[b]
@@ -311,7 +458,10 @@ class ALSAlgorithm(TPUAlgorithm):
             if b in model.item_index
         }
         if query.get("unseenOnly", True):
-            exclude |= _seen_indices(model, query, user_idx)
+            exclude |= (
+                seen if seen is not None
+                else _seen_indices(model, query, user_idx)
+            )
         for idx in exclude:
             scores[idx] = -np.inf
         return topk_item_scores(model.item_ids, scores, num)

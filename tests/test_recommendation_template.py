@@ -142,6 +142,68 @@ class TestRecommendationEngine:
                                    "unseenOnly": False})
         assert {s["item"] for s in raw["itemScores"]} & rated
 
+    def test_streaming_reader_mode(self, movie_app):
+        """"reader": "streaming": the DataSource returns a lazy handle,
+        the preparator streams the store's chunked columnar scan through
+        the sharded reader, and the trained model matches the
+        materialized path at matched seed (the vocab order is identical:
+        both derive from the same deterministic scan order)."""
+        engine = engine_factory()
+        ctx = RuntimeContext()
+
+        def make(reader=None, **extra):
+            obj = {
+                "datasource": {"params": {"appName": "MovieApp",
+                                          "eventNames": ["rate"]}},
+                "algorithms": [{"name": "als", "params": {
+                    "rank": 8, "numIterations": 6, "lambda": 0.05,
+                    "seed": 3, **extra}}],
+            }
+            if reader:
+                obj["datasource"]["params"]["reader"] = reader
+            return EngineParams.from_json_obj(obj)
+
+        params_s = make(reader="streaming", seenFilter="live")
+        models_s = engine.train(ctx, params_s)
+        model_s = models_s[0]
+        assert model_s.seen == {} and model_s.seen_mode == "live"
+        algo = engine._algorithms(params_s)[0]
+        result = algo.predict(model_s, {"user": "g0u0", "num": 2})
+        items = [s["item"] for s in result["itemScores"]]
+        assert len(items) == 2 and all(i.startswith("s") for i in items), items
+
+        # default seenFilter resolves to live in streaming mode; an
+        # explicit "model" is a contradiction and fails loudly
+        models_d = engine.train(ctx, make(reader="streaming"))
+        assert models_d[0].seen_mode == "live"
+        with pytest.raises(ValueError, match="seenFilter"):
+            engine.train(ctx, make(reader="streaming", seenFilter="model"))
+
+    def test_live_filter_downgrades_for_eval_folds(self, movie_app):
+        """pio eval with seenFilter live: the held-out events still exist
+        in the store, so a live read would -inf every 'actual' item and
+        zero the fold metrics -- eval folds train with the (train-edge)
+        seen map instead."""
+        from predictionio_tpu.models.recommendation.engine import (
+            RecommendationDataSource,
+            RecommendationPreparator,
+            ALSAlgorithm,
+        )
+        from predictionio_tpu.controller.base import Params
+
+        ctx = RuntimeContext()
+        ds = RecommendationDataSource(Params({"appName": "MovieApp"}))
+        folds = ds.read_eval(ctx)
+        train_data, _info, pairs = folds[0]
+        assert train_data.eval_fold and pairs
+        prep = RecommendationPreparator(Params({}))
+        prepared = prep.prepare(ctx, train_data)
+        algo = ALSAlgorithm(Params({"rank": 4, "numIterations": 2,
+                                    "seenFilter": "live"}))
+        model = algo.train(ctx, prepared)
+        assert model.seen_mode == "model"  # downgraded
+        assert model.seen  # built from the fold's train edges
+
     def test_unseen_only_filters_rated(self, movie_app):
         engine = engine_factory()
         ctx = RuntimeContext()
