@@ -1,0 +1,117 @@
+"""Shared plumbing for the templates' streaming sharded-reader mode.
+
+One definition of: the lazy DataSource handle (``"reader": "streaming"``),
+its construction from datasource params, and the serving-time live
+event-store lookup that replaces O(edges) trained-in history maps.
+The recommendation, similar-product, and universal templates all build on
+these; template-specific behavior (bucketing, multi-event universes,
+index mapping) stays in the engines.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from predictionio_tpu.controller.base import SanityCheck
+
+logger = logging.getLogger("pio.streaming")
+
+
+@dataclass
+class StreamingHandle(SanityCheck):
+    """Lazy training handle: no arrays, just where/what to stream.
+
+    The preparator/algorithm streams the store's chunked columnar scan
+    through parallel.reader; each process retains only its data-shard's
+    edges.
+    """
+
+    app_name: str
+    app_id: int
+    channel_id: int | None
+    channel_name: str | None
+    event_names: list[str]
+    rating_key: str = "rating"
+    chunk_rows: int = 262_144
+    #: events whose absence means "no data" (UR probes the primary type
+    #: only); None probes all of event_names
+    probe_event_names: list[str] | None = None
+    empty_message: str = "no events found -- check appName and eventNames"
+
+    def sanity_check(self) -> None:
+        from predictionio_tpu.data import storage
+
+        probe = list(
+            storage.get_l_events().find(
+                app_id=self.app_id,
+                channel_id=self.channel_id,
+                event_names=self.probe_event_names or self.event_names,
+                limit=1,
+            )
+        )
+        if not probe:
+            raise ValueError(self.empty_message)
+
+
+def streaming_handle_or_none(
+    params,
+    default_event_names: list[str],
+    probe_primary_only: bool = False,
+    empty_message: str | None = None,
+) -> StreamingHandle | None:
+    """The shared ``read_training`` branch: a StreamingHandle when the
+    datasource params opt in (``"reader": "streaming"``), else None."""
+    if params.get_or("reader", "materialized") != "streaming":
+        return None
+    from predictionio_tpu.data.store import resolve_app_channel
+
+    event_names = params.get_or("eventNames", default_event_names)
+    app_id, channel_id = resolve_app_channel(
+        params.appName, params.get_or("channelName", None)
+    )
+    return StreamingHandle(
+        app_name=params.appName,
+        app_id=app_id,
+        channel_id=channel_id,
+        channel_name=params.get_or("channelName", None),
+        event_names=list(event_names),
+        rating_key=params.get_or("ratingKey", "rating"),
+        chunk_rows=params.get_or("chunkRows", 262_144),
+        probe_event_names=[event_names[0]] if probe_primary_only else None,
+        empty_message=empty_message
+        or "no events found -- check appName and eventNames",
+    )
+
+
+def live_target_events(model, user: str) -> list:
+    """The query user's item-target events, read live from the store.
+
+    Reads the model's ``app_name``/``channel_name``/``event_names``
+    (getattr-safe: pickled models may predate the fields). Degrades to an
+    empty list -- with one warning -- on any store error: serving must
+    not 500 because a backend blinked. An unresolvable app short-circuits
+    without a per-request failing lookup.
+    """
+    app_name = getattr(model, "app_name", "")
+    if not user or not app_name:
+        return []
+    from predictionio_tpu.data.store import LEventStore
+
+    try:
+        return list(
+            LEventStore.find(
+                app_name,
+                entity_type="user",
+                entity_id=user,
+                channel_name=getattr(model, "channel_name", None),
+                event_names=getattr(model, "event_names", None) or None,
+                target_entity_type="item",
+            )
+        )
+    except Exception:
+        logger.warning(
+            "live history lookup failed; serving without user history",
+            exc_info=True,
+        )
+        return []

@@ -31,6 +31,11 @@ from predictionio_tpu.models._als_common import (
     topk_item_scores,
     warn_misplaced_packing_params,
 )
+from predictionio_tpu.models._streaming import (
+    StreamingHandle,
+    live_target_events,
+    streaming_handle_or_none,
+)
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
 
 logger = logging.getLogger("pio.recommendation")
@@ -73,40 +78,10 @@ class RatingsData(SanityCheck):
         return len(self.item_ids)
 
 
-@dataclass
-class StreamingRatings(SanityCheck):
-    """Lazy handle for the sharded-reader training path (no arrays).
-
-    ``"reader": "streaming"`` makes the DataSource return THIS instead of
-    materialized COO arrays: the preparator then streams the store's
-    chunked columnar scan and each process retains only its data-shard's
-    edges (parallel.reader) -- `pio train` on a multi-host pod never
-    materializes the global edge set on any host. Requires
-    ``seenFilter: "live"`` (an O(edges) trained-in seen map would defeat
-    the point).
-    """
-
-    app_name: str
-    app_id: int
-    channel_id: int | None
-    channel_name: str | None
-    event_names: list[str]
-    rating_key: str
-    chunk_rows: int = 262_144
-
-    def sanity_check(self) -> None:
-        from predictionio_tpu.data import storage
-
-        probe = list(
-            storage.get_l_events().find(
-                app_id=self.app_id, channel_id=self.channel_id,
-                event_names=self.event_names, limit=1,
-            )
-        )
-        if not probe:
-            raise ValueError(
-                "no rating events found -- check appName and eventNames"
-            )
+#: the sharded-reader training handle (see models/_streaming): the
+#: preparator streams the chunked scan and each process retains only its
+#: data-shard's edges; requires seenFilter "live"
+StreamingRatings = StreamingHandle
 
 
 class RecommendationDataSource(DataSource):
@@ -141,22 +116,12 @@ class RecommendationDataSource(DataSource):
         )
 
     def read_training(self, ctx):
-        if self.params.get_or("reader", "materialized") == "streaming":
-            from predictionio_tpu.data.store import resolve_app_channel
-
-            app_id, channel_id = resolve_app_channel(
-                self.params.appName, self.params.get_or("channelName", None)
-            )
-            return StreamingRatings(
-                app_name=self.params.appName,
-                app_id=app_id,
-                channel_id=channel_id,
-                channel_name=self.params.get_or("channelName", None),
-                event_names=self.params.get_or("eventNames", ["rate", "buy"]),
-                rating_key=self.params.get_or("ratingKey", "rating"),
-                chunk_rows=self.params.get_or("chunkRows", 262_144),
-            )
-        return self._read()
+        handle = streaming_handle_or_none(
+            self.params, ["rate", "buy"],
+            empty_message="no rating events found -- check appName and "
+            "eventNames",
+        )
+        return handle if handle is not None else self._read()
 
     def read_eval(self, ctx):
         """Time-ordered k-fold: hold out each fold's interactions as
@@ -294,31 +259,11 @@ def _seen_indices(model: "RecommendationModel", query, user_idx: int) -> set[int
     """
     if getattr(model, "seen_mode", "model") != "live":
         return model.seen.get(user_idx, set())
-    if not getattr(model, "app_name", ""):
-        return set()  # nothing to resolve; don't pay a failing store
-        # lookup + warning per request (ecommerce template pattern)
-    from predictionio_tpu.data.store import LEventStore
-
-    try:
-        events = LEventStore.find(
-            getattr(model, "app_name", ""),
-            entity_type="user",
-            entity_id=str(query.get("user")),
-            channel_name=getattr(model, "channel_name", None),
-            event_names=getattr(model, "event_names", None) or None,
-            target_entity_type="item",
-        )
-        return {
-            model.item_index[e.target_entity_id]
-            for e in events
-            if e.target_entity_id in model.item_index
-        }
-    except Exception:
-        logger.warning(
-            "live seen-filter lookup failed; serving unfiltered",
-            exc_info=True,
-        )
-        return set()
+    return {
+        model.item_index[e.target_entity_id]
+        for e in live_target_events(model, str(query.get("user")))
+        if e.target_entity_id in model.item_index
+    }
 
 
 class ALSAlgorithm(TPUAlgorithm):

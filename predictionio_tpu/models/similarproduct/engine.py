@@ -25,6 +25,11 @@ from predictionio_tpu.ops.cooccurrence import (
     cooccurrence_indicators,
     distinct_user_counts,
 )
+from predictionio_tpu.models._streaming import (
+    StreamingHandle,
+    live_target_events,
+    streaming_handle_or_none,
+)
 from predictionio_tpu.ops.ragged import pack_padded_csr
 
 
@@ -42,7 +47,11 @@ class InteractionData(SanityCheck):
 
 
 class SimilarProductDataSource(DataSource):
-    """Params: appName, eventNames (default ["view", "buy"]), maxEventsPerUser."""
+    """Params: appName, eventNames (default ["view", "buy"]),
+    maxEventsPerUser; ``"reader": "streaming"`` trains through the
+    retention-bounded sharded reader (each process keeps only its
+    data-shard's user rows) and serves user-anchored queries from live
+    event-store reads."""
 
     def _read(self) -> InteractionData:
         ds = PEventStore.dataset(
@@ -59,8 +68,12 @@ class SimilarProductDataSource(DataSource):
             item_ids=ds.target_entity_id_vocab,
         )
 
-    def read_training(self, ctx) -> InteractionData:
-        return self._read()
+    def read_training(self, ctx):
+        handle = streaming_handle_or_none(
+            self.params, ["view", "buy"],
+            empty_message="no interaction events found",
+        )
+        return handle if handle is not None else self._read()
 
     def read_eval(self, ctx):
         """Hold out each user's most recent interaction; query with the rest."""
@@ -103,49 +116,113 @@ class SimilarityModel:
     top_indices: np.ndarray  # [items, k]
     top_values: np.ndarray   # [items, k]
     user_history: dict[str, list[int]]
+    #: "model": user-anchored queries read the trained-in map above;
+    #: "live": per-query event-store read (O(entities) serving model --
+    #: the streaming reader's contract, and fresh events anchor without
+    #: retrain). Old pickles predate these; readers use getattr defaults.
+    history_mode: str = "model"
+    app_name: str = ""
+    channel_name: str = None
+    event_names: list[str] = None
+
+
+def _user_anchor_items(model: "SimilarityModel", user: str) -> list[int]:
+    """The user's interacted item indices to anchor a {"user": ...} query.
+
+    Live mode reads the event store per request (fresh interactions anchor
+    immediately, the model carries no O(edges) map); a store error
+    degrades to no anchors rather than a 500.
+    """
+    if getattr(model, "history_mode", "model") != "live":
+        return model.user_history.get(user, [])
+    return [
+        model.item_index[e.target_entity_id]
+        for e in live_target_events(model, user)
+        if e.target_entity_id in model.item_index
+    ]
 
 
 class CooccurrenceAlgorithm(TPUAlgorithm):
     """Params: topK (indicators per item, default 50), llr (default True),
     chunk (users per device matmul chunk)."""
 
-    def train(self, ctx, data: InteractionData) -> SimilarityModel:
-        csr = pack_padded_csr(
-            data.users,
-            data.items,
-            np.ones(data.users.size, dtype=np.float32),
-            num_rows=len(data.user_ids),
-            num_cols=len(data.item_ids),
-            times=data.times,
-            max_len=self.params.get_or("maxEventsPerUser", None),
-        )
+    def train(self, ctx, data) -> SimilarityModel:
+        chunk = self.params.get_or("chunk", 4096)
+        mesh = self.mesh_or_none(ctx)  # user rows dp-sharded, psum acc
+        streamed = isinstance(data, StreamingHandle)
+        if streamed:
+            from predictionio_tpu.data import storage
+            from predictionio_tpu.parallel.mesh import local_mesh
+            from predictionio_tpu.parallel.reader import (
+                build_cooc_csr_sharded,
+                distinct_user_counts_sharded,
+                store_coo_chunks,
+            )
+
+            mesh = mesh or local_mesh(1, 1)
+            source, users_enc, items_enc = store_coo_chunks(
+                storage.get_l_events(),
+                data.app_id,
+                channel_id=data.channel_id,
+                event_names=data.event_names,
+                chunk_rows=data.chunk_rows,
+            )
+            csr = build_cooc_csr_sharded(
+                source, None, None, mesh,
+                max_len=self.params.get_or("maxEventsPerUser", None),
+                chunk=chunk,
+            )
+            user_ids, item_ids = users_enc.ids, items_enc.ids
+            totals_fn = lambda: distinct_user_counts_sharded(csr)
+        else:
+            csr = pack_padded_csr(
+                data.users,
+                data.items,
+                np.ones(data.users.size, dtype=np.float32),
+                num_rows=len(data.user_ids),
+                num_cols=len(data.item_ids),
+                times=data.times,
+                max_len=self.params.get_or("maxEventsPerUser", None),
+            )
+            user_ids, item_ids = data.user_ids, data.item_ids
+            totals_fn = lambda: distinct_user_counts(csr)
         # fused on-device cooc -> (LLR) -> top-k; the self-cooccurrence
         # diagonal (= per-item distinct-user counts) comes from the O(nnz)
         # host pass so the [items, items] matrix never leaves the device
         llr_kwargs = {}
         if self.params.get_or("llr", True):
-            totals = distinct_user_counts(csr)
+            totals = totals_fn()
             llr_kwargs = dict(
                 llr_row_totals=totals,
                 llr_col_totals=totals,
-                total=len(data.user_ids),
+                total=len(user_ids),
             )
         idx, vals = cooccurrence_indicators(
             csr,
             top_k=self.params.get_or("topK", 50),
-            chunk=self.params.get_or("chunk", 4096),
-            mesh=self.mesh_or_none(ctx),  # user rows dp-sharded, psum acc
+            chunk=chunk,
+            mesh=mesh,
             **llr_kwargs,
         )
-        history: dict[str, list[int]] = {}
-        for u, i in zip(data.users, data.items):
-            history.setdefault(data.user_ids[int(u)], []).append(int(i))
+        if streamed:
+            # no O(edges) history map exists; user queries read the store
+            history: dict[str, list[int]] = {}
+            mode = "live"
+        else:
+            history = {}
+            for u, i in zip(data.users, data.items):
+                history.setdefault(data.user_ids[int(u)], []).append(int(i))
+            mode = "model"
         return SimilarityModel(
-            item_ids=data.item_ids,
-            item_index={iid: j for j, iid in enumerate(data.item_ids)},
-            top_indices=idx,
-            top_values=vals,
+            item_ids=item_ids,
+            item_index={iid: j for j, iid in enumerate(item_ids)},
+            top_indices=np.asarray(idx),
+            top_values=np.asarray(vals),
             user_history=history,
+            history_mode=mode,
+            app_name=data.app_name if streamed else "",
+            channel_name=data.channel_name if streamed else None,
+            event_names=list(data.event_names) if streamed else None,
         )
 
     def predict(self, model: SimilarityModel, query) -> dict:
@@ -157,7 +234,7 @@ class CooccurrenceAlgorithm(TPUAlgorithm):
                 if str(i) in model.item_index
             ]
         elif "user" in query:
-            anchors = model.user_history.get(str(query["user"]), [])
+            anchors = _user_anchor_items(model, str(query["user"]))
         else:
             raise ValueError("query must contain 'items' or 'user'")
         if not anchors:

@@ -25,6 +25,16 @@ from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.ops.cooccurrence import cooccurrence_indicators
 from predictionio_tpu.ops.ragged import pack_padded_csr
 
+import logging
+
+from predictionio_tpu.models._streaming import (
+    StreamingHandle,
+    live_target_events,
+    streaming_handle_or_none,
+)
+
+logger = logging.getLogger("pio.universal")
+
 
 @dataclass
 class MultiEventData(SanityCheck):
@@ -43,7 +53,10 @@ class MultiEventData(SanityCheck):
 
 
 class URDataSource(DataSource):
-    """Params: appName, eventNames (primary first; default ["buy", "view"])."""
+    """Params: appName, eventNames (primary first; default ["buy", "view"]);
+    ``"reader": "streaming"`` trains every event type's cross-occurrence
+    through the retention-bounded sharded reader over one shared entity
+    universe, and serves user queries from live event-store reads."""
 
     def _read(self) -> MultiEventData:
         event_names = self.params.get_or("eventNames", ["buy", "view"])
@@ -90,8 +103,15 @@ class URDataSource(DataSource):
             item_properties=item_props,
         )
 
-    def read_training(self, ctx) -> MultiEventData:
-        return self._read()
+    def read_training(self, ctx):
+        handle = streaming_handle_or_none(
+            self.params, ["buy", "view"], probe_primary_only=True
+        )
+        if handle is not None:
+            handle.empty_message = (
+                f"no events of primary type {handle.event_names[0]!r} found"
+            )
+        return handle if handle is not None else self._read()
 
     def read_eval(self, ctx):
         """Hold out each user's most recent PRIMARY interaction."""
@@ -135,6 +155,13 @@ class URModel:
     #: user id -> {event type -> [item indices]}
     user_history: dict[str, dict[str, list[int]]]
     item_properties: dict[str, dict]
+    #: "model": the trained-in map above; "live": per-query event-store
+    #: read (O(entities) serving -- the streaming reader's contract, and
+    #: fresh events enter the history without retrain). Old pickles
+    #: predate these fields; readers use getattr defaults.
+    history_mode: str = "model"
+    app_name: str = ""
+    channel_name: str = None
 
 
 def _invert_indicators(
@@ -148,16 +175,36 @@ def _invert_indicators(
     return inverted
 
 
+def _user_history(model: "URModel", user: str) -> dict[str, list[int]]:
+    """{event type -> [item indices]} for the query user.
+
+    Live mode reads the event store per request (the streaming reader's
+    serving contract); a store error degrades to an empty history rather
+    than a 500.
+    """
+    if getattr(model, "history_mode", "model") != "live":
+        return dict(model.user_history.get(user, {}))
+    out: dict[str, list[int]] = {}
+    for e in live_target_events(model, user):
+        j = model.item_index.get(e.target_entity_id)
+        if j is not None:
+            out.setdefault(e.event, []).append(j)
+    return out
+
+
 class URAlgorithm(TPUAlgorithm):
     """Params: topK (indicators per anchor, default 50), maxEventsPerUser,
     chunk."""
 
-    def train(self, ctx, data: MultiEventData) -> URModel:
-        n_users, n_items = len(data.user_ids), len(data.item_ids)
+    def train(self, ctx, data) -> URModel:
         max_len = self.params.get_or("maxEventsPerUser", None)
         chunk = self.params.get_or("chunk", 4096)
         top_k = self.params.get_or("topK", 50)
         mesh = self.mesh_or_none(ctx)  # user rows dp-sharded, psum acc
+        streamed = isinstance(data, StreamingHandle)
+        if streamed:
+            return self._train_streaming(ctx, data, max_len, chunk, top_k, mesh)
+        n_users, n_items = len(data.user_ids), len(data.item_ids)
 
         def to_csr(triples):
             uu, ii, tt = triples
@@ -211,9 +258,93 @@ class URAlgorithm(TPUAlgorithm):
             item_properties=data.item_properties,
         )
 
+    def _train_streaming(self, ctx, src, max_len, chunk, top_k, mesh) -> URModel:
+        """Every event type's CSR through the sharded reader over ONE
+        shared entity universe (store_multi_event_chunks' shared
+        encoders); indicators come out bit-identical to the materialized
+        path. Costs 1 + 2 * len(event_names) scans -- bounded memory is
+        the trade."""
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.store import PEventStore
+        from predictionio_tpu.parallel.mesh import local_mesh
+        from predictionio_tpu.parallel.reader import (
+            build_cooc_csr_sharded,
+            distinct_user_counts_sharded,
+            store_multi_event_chunks,
+            universe_pass,
+        )
+
+        mesh = mesh or local_mesh(1, 1)
+        sources, users_enc, items_enc = store_multi_event_chunks(
+            storage.get_l_events(),
+            src.app_id,
+            src.event_names,
+            channel_id=src.channel_id,
+            chunk_rows=src.chunk_rows,
+        )
+        universe_pass(sources)  # fix the shared universe before any build
+        n_users, n_items = len(users_enc.ids), len(items_enc.ids)
+
+        primary = src.event_names[0]
+        primary_csr = build_cooc_csr_sharded(
+            sources[primary], n_users, n_items, mesh,
+            max_len=max_len, chunk=chunk,
+        )
+        primary_counts = distinct_user_counts_sharded(primary_csr)
+        indicators = {}
+        for name in src.event_names:
+            is_primary = name == primary
+            csr = (
+                primary_csr if is_primary
+                else build_cooc_csr_sharded(
+                    sources[name], n_users, n_items, mesh,
+                    max_len=max_len, chunk=chunk,
+                )
+            )
+            if csr.global_edges == 0 and not is_primary:
+                # GLOBAL emptiness (from the counts pass): every process
+                # takes the same branch, so the collective indicator
+                # build below never diverges across the mesh
+                continue
+            col_counts = (
+                primary_counts if is_primary
+                else distinct_user_counts_sharded(csr)
+            )
+            indicators[name] = _invert_indicators(
+                *cooccurrence_indicators(
+                    primary_csr,
+                    None if is_primary else csr,
+                    top_k=top_k,
+                    llr_row_totals=primary_counts,
+                    llr_col_totals=col_counts,
+                    total=n_users,
+                    drop_diagonal=is_primary,
+                    chunk=chunk,
+                    mesh=mesh,
+                )
+            )
+        item_props = {
+            iid: pm.to_dict()
+            for iid, pm in PEventStore.aggregate_properties(
+                src.app_name, entity_type="item",
+                channel_name=src.channel_name,
+            ).items()
+        }
+        return URModel(
+            event_names=list(src.event_names),
+            item_ids=items_enc.ids,
+            item_index={iid: j for j, iid in enumerate(items_enc.ids)},
+            indicators=indicators,
+            user_history={},
+            item_properties=item_props,
+            history_mode="live",
+            app_name=src.app_name,
+            channel_name=src.channel_name,
+        )
+
     def predict(self, model: URModel, query) -> dict:
         num = int(query.get("num", 10))
-        history = dict(model.user_history.get(str(query.get("user", "")), {}))
+        history = _user_history(model, str(query.get("user", "")))
         # item-anchored queries act as view-history of the primary type
         if "items" in query:
             anchors = [

@@ -124,6 +124,79 @@ def store_coo_chunks(
     return source, users_enc, items_enc
 
 
+def store_multi_event_chunks(
+    l_events,
+    app_id: int,
+    event_names: list[str],
+    channel_id: int | None = None,
+    rating_key: str = "rating",
+    chunk_rows: int = 262_144,
+    default_value: float = 1.0,
+) -> tuple[dict[str, ChunkSource], IncrementalEncoder, IncrementalEncoder]:
+    """Per-event-type COO chunk sources over ONE shared entity universe.
+
+    The Universal Recommender's cross-occurrence needs every event type's
+    CSR row-indexed by the same user universe. Each returned source
+    replays the SAME full multi-type scan and encodes EVERY row through
+    the shared encoders (so ids are identical no matter which type's
+    source runs first, or how often), emitting only its own type's rows.
+    A per-type two-pass build therefore costs 2 * len(event_names) scans
+    -- streaming-bounded memory is the trade.
+    """
+    users_enc, items_enc = IncrementalEncoder(), IncrementalEncoder()
+
+    def source_for(wanted: str) -> ChunkSource:
+        def source() -> Iterator[Chunk]:
+            for ents, tgts, names, times_iso, _ratings in (
+                l_events.iter_interaction_chunks(
+                    app_id=app_id,
+                    channel_id=channel_id,
+                    event_names=event_names,
+                    rating_key=rating_key,
+                    chunk_rows=chunk_rows,
+                )
+            ):
+                keep = [k for k, t in enumerate(tgts) if t is not None]
+                uu = users_enc.encode([ents[k] for k in keep])
+                ii = items_enc.encode([tgts[k] for k in keep])
+                sel = np.fromiter(
+                    (names[k] == wanted for k in keep),
+                    dtype=bool,
+                    count=len(keep),
+                )
+                if not sel.any():
+                    continue
+                tt = np.fromiter(
+                    (
+                        _dt.datetime.fromisoformat(times_iso[k]).timestamp()
+                        for k, s in zip(keep, sel)
+                        if s
+                    ),
+                    dtype=np.float64,
+                    count=int(sel.sum()),
+                )
+                yield (
+                    uu[sel], ii[sel],
+                    np.full(int(sel.sum()), default_value, np.float32),
+                    tt,
+                )
+
+        return source
+
+    return {n: source_for(n) for n in event_names}, users_enc, items_enc
+
+
+def universe_pass(sources: dict[str, ChunkSource]) -> None:
+    """Drive one full scan through the shared encoders so the entity
+    universe (len(encoder.ids)) is known before any per-type build.
+
+    Any single source suffices: every source encodes ALL types' rows
+    through the shared encoders regardless of which type it emits.
+    """
+    for _ in next(iter(sources.values()))():
+        pass
+
+
 def _local_row_range(sharding, nrows: int) -> tuple[int, int]:
     """This process's contiguous [lo, hi) slice of a row-sharded dim."""
     spans = {
@@ -295,6 +368,11 @@ class ShardedPaddedCSR:
     num_rows: int   # real (global) user rows
     num_cols: int
     retained_edges: int
+    #: GLOBAL edge count from the counts pass (identical on every
+    #: process). Emptiness decisions MUST use this, never retained_edges:
+    #: a per-process test diverges SPMD control flow around the
+    #: collectives when one process's shard happens to hold no edges.
+    global_edges: int = 0
 
     @property
     def max_len(self) -> int:
@@ -395,6 +473,7 @@ def build_cooc_csr_sharded(
         num_rows=n_users,
         num_cols=n_items,
         retained_edges=retained,
+        global_edges=int(cnt_u.sum()),
     )
 
 
