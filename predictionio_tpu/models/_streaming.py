@@ -11,7 +11,7 @@ index mapping) stays in the engines.
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from predictionio_tpu.controller.base import SanityCheck
 
@@ -38,6 +38,11 @@ class StreamingHandle(SanityCheck):
     #: only); None probes all of event_names
     probe_event_names: list[str] | None = None
     empty_message: str = "no events found -- check appName and eventNames"
+    #: template-specific DATASOURCE knobs the preparator/algorithm need
+    #: (e.g. e-commerce buyWeight/buyEvents): DASE keeps per-component
+    #: params separate, so values configured on the datasource must ride
+    #: the handle to reach the streaming build
+    extras: dict = field(default_factory=dict)
 
     def sanity_check(self) -> None:
         from predictionio_tpu.data import storage

@@ -44,6 +44,11 @@ from predictionio_tpu.models._als_common import (
     topk_item_scores,
     warn_misplaced_packing_params,
 )
+from predictionio_tpu.models._streaming import (
+    StreamingHandle,
+    live_target_events,
+    streaming_handle_or_none,
+)
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
 
 logger = logging.getLogger("pio.ecommerce")
@@ -61,10 +66,33 @@ class ECommerceData(SanityCheck):
     item_ids: list[str]
     app_name: str = ""       # carried to the model for live serving reads
     categories: dict[str, list[str]] = field(default_factory=dict)
+    channel_name: str = None
+    event_names: list[str] = None  # the types this model trained on
+    streamed: bool = False   # built by the sharded reader: edge arrays empty
 
     def sanity_check(self) -> None:
         if self.users.size == 0:
             raise ValueError("no view/buy events found -- check appName")
+
+
+def _buy_confidences(params, event_names: list[str]) -> dict[str, float]:
+    """event type -> implicit confidence (exact buy names boosted)."""
+    buy_weight = float(params.get_or("buyWeight", 2.0))
+    buy_events = set(params.get_or("buyEvents", ["buy"]))
+    return {
+        n: buy_weight if n in buy_events else 1.0 for n in event_names
+    }
+
+
+def _load_categories(app_name: str, channel_name=None) -> dict[str, list[str]]:
+    props = PEventStore.aggregate_properties(
+        app_name, "item", channel_name=channel_name
+    )
+    return {
+        item_id: list(pm.get("categories", []) or [])
+        for item_id, pm in props.items()
+        if pm.get("categories", None)
+    }
 
 
 class ECommerceDataSource(DataSource):
@@ -93,12 +121,7 @@ class ECommerceDataSource(DataSource):
             if name in buy_events
         ]
         weights[np.isin(ds.event_names[valid], buy_codes)] = buy_weight
-        props = PEventStore.aggregate_properties(self.params.appName, "item")
-        categories = {
-            item_id: list(pm.get("categories", []) or [])
-            for item_id, pm in props.items()
-            if pm.get("categories", None)
-        }
+        categories = _load_categories(self.params.appName)
         return ECommerceData(
             users=ds.entity_ids[valid],
             items=ds.target_entity_ids[valid],
@@ -110,7 +133,18 @@ class ECommerceDataSource(DataSource):
             categories=categories,
         )
 
-    def read_training(self, ctx) -> ECommerceData:
+    def read_training(self, ctx):
+        handle = streaming_handle_or_none(
+            self.params, ["view", "buy"],
+            empty_message="no view/buy events found -- check appName",
+        )
+        if handle is not None:
+            # DATASOURCE knobs the streaming build needs (DASE keeps
+            # per-component params separate)
+            handle.extras["event_values"] = _buy_confidences(
+                self.params, handle.event_names
+            )
+            return handle
         return self._read()
 
     def read_eval(self, ctx):
@@ -141,9 +175,16 @@ class ECommerceDataSource(DataSource):
 
 
 class ECommercePreparator(Preparator):
-    """Packs interactions into mesh-sized padded CSR blocks (ALX layout)."""
+    """Packs interactions into mesh-sized padded CSR blocks (ALX layout).
 
-    def prepare(self, ctx, data: ECommerceData):
+    A StreamingHandle (datasource ``"reader": "streaming"``) routes
+    through the retention-bounded sharded reader with the buy-weighted
+    implicit confidences applied per event type in the stream.
+    """
+
+    def prepare(self, ctx, data):
+        if isinstance(data, StreamingHandle):
+            return self._prepare_streaming(ctx, data)
         als_data = prepare_als_data(
             ctx,
             self.params,
@@ -153,6 +194,54 @@ class ECommercePreparator(Preparator):
             len(data.user_ids),
             len(data.item_ids),
             times=data.times,
+        )
+        return data, als_data
+
+    def _prepare_streaming(self, ctx, src: StreamingHandle):
+        import numpy as _np
+
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.store import PEventStore
+        from predictionio_tpu.parallel.reader import (
+            build_als_data_sharded,
+            store_coo_chunks,
+        )
+
+        # the DATASOURCE's confidence scheme, applied in-stream (it rides
+        # the handle: preparator params are a different DASE component)
+        event_values = src.extras.get("event_values") or {
+            n: 1.0 for n in src.event_names
+        }
+        config = ALSConfig(
+            max_len=self.params.get_or("maxEventsPerUser", None),
+            buckets=self.params.get_or("buckets", 1),
+        )
+        mesh = ctx.mesh
+        source, users_enc, items_enc = store_coo_chunks(
+            storage.get_l_events(),
+            src.app_id,
+            channel_id=src.channel_id,
+            event_names=src.event_names,
+            chunk_rows=src.chunk_rows,
+            event_values=event_values,
+        )
+        als_data = build_als_data_sharded(
+            source, None, None, config, mesh,
+            model_shards=mesh.shape.get("model", 1),
+        )
+        categories = _load_categories(src.app_name, src.channel_name)
+        data = ECommerceData(
+            users=_np.empty(0, _np.int64),
+            items=_np.empty(0, _np.int64),
+            weights=_np.empty(0, _np.float32),
+            times=_np.empty(0, _np.float64),
+            user_ids=users_enc.ids,
+            item_ids=items_enc.ids,
+            app_name=src.app_name,
+            categories=categories,
+            channel_name=src.channel_name,
+            event_names=list(src.event_names),
+            streamed=True,
         )
         return data, als_data
 
@@ -170,6 +259,12 @@ class ECommerceModel:
     #: category -> sorted item indices (query-time mask building)
     category_items: dict[str, np.ndarray]
     similar_events: list[str]
+    #: "model": the trained-in seen map; "live": per-query event-store
+    #: read (streaming-reader serving contract -- O(entities) model).
+    #: Old pickles predate these fields; readers use getattr defaults.
+    seen_mode: str = "model"
+    channel_name: str = None
+    event_names: list[str] = None
 
 
 class ECommAlgorithm(TPUAlgorithm):
@@ -209,7 +304,8 @@ class ECommAlgorithm(TPUAlgorithm):
             interval=self.params.get_or("checkpointInterval", 5),
             name="ecomm-als",
         )
-        seen = build_seen(data.users, data.items)
+        streamed = getattr(data, "streamed", False)
+        seen = {} if streamed else build_seen(data.users, data.items)
         item_index = {iid: j for j, iid in enumerate(data.item_ids)}
         by_cat: dict[str, list[int]] = {}
         for item_id, cats in data.categories.items():
@@ -228,6 +324,9 @@ class ECommAlgorithm(TPUAlgorithm):
                 c: np.asarray(sorted(js), dtype=np.int64) for c, js in by_cat.items()
             },
             similar_events=self.params.get_or("similarEvents", ["view"]),
+            seen_mode="live" if streamed else "model",
+            channel_name=getattr(data, "channel_name", None),
+            event_names=getattr(data, "event_names", None),
         )
 
     # ------------------------------------------------------------------
@@ -247,6 +346,7 @@ class ECommAlgorithm(TPUAlgorithm):
                     model.app_name,
                     entity_type="constraint",
                     entity_id="unavailableItems",
+                    channel_name=getattr(model, "channel_name", None),
                     event_names=["$set"],
                     limit=1,
                     latest=True,
@@ -272,6 +372,7 @@ class ECommAlgorithm(TPUAlgorithm):
                 model.app_name,
                 entity_type="user",
                 entity_id=user,
+                channel_name=getattr(model, "channel_name", None),
                 event_names=model.similar_events,
                 limit=count,
                 latest=True,
@@ -290,6 +391,23 @@ class ECommAlgorithm(TPUAlgorithm):
     def warm_up(self, model: ECommerceModel) -> None:
         model.als.item_norms  # cold-user similarity norm cache, at deploy
 
+    @staticmethod
+    def _seen(model: ECommerceModel, query, user_idx, cache) -> set[int]:
+        """Already-interacted item indices; live mode reads the store
+        (memoized per distinct user when the batch path passes a cache)."""
+        if getattr(model, "seen_mode", "model") != "live":
+            return model.seen.get(user_idx, set())
+        if cache is not None and user_idx in cache:
+            return cache[user_idx]
+        out = {
+            model.item_index[e.target_entity_id]
+            for e in live_target_events(model, str(query.get("user")))
+            if e.target_entity_id in model.item_index
+        }
+        if cache is not None:
+            cache[user_idx] = out
+        return out
+
     def _apply_rules(
         self,
         model: ECommerceModel,
@@ -298,9 +416,11 @@ class ECommAlgorithm(TPUAlgorithm):
         user_idx,
         anchors,
         unavailable: set[int],
+        seen_cache: dict | None = None,
     ) -> dict:
         """Business-rule filtering + ranking shared by predict and
-        batch_predict (which resolves ``unavailable`` ONCE per batch)."""
+        batch_predict (which resolves ``unavailable`` ONCE per batch and
+        memoizes live seen lookups per distinct user)."""
         n_items = scores.shape[0]
         if query.get("whiteList"):
             allowed = np.zeros(n_items, dtype=bool)
@@ -326,7 +446,7 @@ class ECommAlgorithm(TPUAlgorithm):
         if user_idx is not None and query.get(
             "unseenOnly", self.params.get_or("unseenOnly", True)
         ):
-            exclude |= model.seen.get(user_idx, set())
+            exclude |= self._seen(model, query, user_idx, seen_cache)
         scores = np.where(allowed, scores, -np.inf)
         for j in exclude:
             scores[j] = -np.inf
@@ -372,12 +492,16 @@ class ECommAlgorithm(TPUAlgorithm):
         through the fallback loop."""
         user_rows, fallback = partition_user_queries(model.user_index, queries)
         unavailable = self._unavailable_items(model) if queries else set()
+        seen_cache: dict = {}
         out = batch_score_known_users(
             model.als,
             user_rows,
             lambda scores, qid, q, user_idx: (
                 qid,
-                self._apply_rules(model, scores, q, user_idx, [], unavailable),
+                self._apply_rules(
+                    model, scores, q, user_idx, [], unavailable,
+                    seen_cache=seen_cache,
+                ),
             ),
         )
         for qid, q in fallback:

@@ -79,6 +79,7 @@ def store_coo_chunks(
     rating_key: str = "rating",
     chunk_rows: int = 262_144,
     default_value: float = 1.0,
+    event_values: dict[str, float] | None = None,
 ) -> tuple[ChunkSource, IncrementalEncoder, IncrementalEncoder]:
     """COO chunk source over a backend's columnar chunked scan.
 
@@ -86,6 +87,8 @@ def store_coo_chunks(
     stream order during the first pass and are the id<->index mapping the
     serving model needs. Rows with no numeric rating carry
     ``default_value`` (implicit-feedback events like "view"/"buy").
+    ``event_values`` maps EVENT TYPE -> value instead (the e-commerce
+    buy-weighted confidence scheme), ignoring per-row ratings entirely.
     Requires the backend to expose ``iter_interaction_chunks`` (the SQL
     family does); others can stream through any adapter that yields the
     same five columns.
@@ -93,7 +96,7 @@ def store_coo_chunks(
     users_enc, items_enc = IncrementalEncoder(), IncrementalEncoder()
 
     def source() -> Iterator[Chunk]:
-        for ents, tgts, _names, times_iso, ratings in l_events.iter_interaction_chunks(
+        for ents, tgts, names, times_iso, ratings in l_events.iter_interaction_chunks(
             app_id=app_id,
             channel_id=channel_id,
             event_names=event_names,
@@ -103,13 +106,13 @@ def store_coo_chunks(
             keep = [i for i, t in enumerate(tgts) if t is not None]
             uu = users_enc.encode([ents[i] for i in keep])
             ii = items_enc.encode([tgts[i] for i in keep])
+            def value_of(i):
+                if event_values is not None:
+                    return event_values.get(names[i], default_value)
+                return default_value if ratings[i] is None else float(ratings[i])
+
             vals = np.fromiter(
-                (
-                    default_value if ratings[i] is None else float(ratings[i])
-                    for i in keep
-                ),
-                dtype=np.float32,
-                count=len(keep),
+                (value_of(i) for i in keep), dtype=np.float32, count=len(keep)
             )
             tt = np.fromiter(
                 (
