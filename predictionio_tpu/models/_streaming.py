@@ -120,3 +120,58 @@ def live_target_events(model, user: str) -> list:
             exc_info=True,
         )
         return []
+
+
+def live_seen_indices(model, user: str, cache: dict | None = None) -> set[int]:
+    """The user's already-interacted item indices, read live.
+
+    THE live seen-lookup (recommendation, NCF, and e-commerce all filter
+    through it): item ids map through ``model.item_index``; ``cache``
+    memoizes per user for bulk paths. Store errors degrade inside
+    live_target_events.
+    """
+    key = user
+    if cache is not None and key in cache:
+        return cache[key]
+    out = {
+        model.item_index[e.target_entity_id]
+        for e in live_target_events(model, user)
+        if e.target_entity_id in model.item_index
+    }
+    if cache is not None:
+        cache[key] = out
+    return out
+
+
+def build_streaming_als(handle: StreamingHandle, preparator_params, mesh,
+                        event_values: dict[str, float] | None = None):
+    """The shared streaming ALS build both ALS-family templates run:
+    chunked store scan -> retention-bounded sharded pack. Returns
+    ``(users_enc, items_enc, als_data)``; the caller assembles its own
+    template-specific data carrier around the vocabularies.
+    """
+    from predictionio_tpu.data import storage
+    from predictionio_tpu.parallel.als import ALSConfig
+    from predictionio_tpu.parallel.reader import (
+        build_als_data_sharded,
+        store_coo_chunks,
+    )
+
+    config = ALSConfig(
+        max_len=preparator_params.get_or("maxEventsPerUser", None),
+        buckets=preparator_params.get_or("buckets", 1),
+    )
+    source, users_enc, items_enc = store_coo_chunks(
+        storage.get_l_events(),
+        handle.app_id,
+        channel_id=handle.channel_id,
+        event_names=handle.event_names,
+        rating_key=handle.rating_key,
+        chunk_rows=handle.chunk_rows,
+        event_values=event_values,
+    )
+    als_data = build_als_data_sharded(
+        source, None, None, config, mesh,
+        model_shards=mesh.shape.get("model", 1),
+    )
+    return users_enc, items_enc, als_data

@@ -46,7 +46,6 @@ from predictionio_tpu.models._als_common import (
 )
 from predictionio_tpu.models._streaming import (
     StreamingHandle,
-    live_target_events,
     streaming_handle_or_none,
 )
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
@@ -200,34 +199,15 @@ class ECommercePreparator(Preparator):
     def _prepare_streaming(self, ctx, src: StreamingHandle):
         import numpy as _np
 
-        from predictionio_tpu.data import storage
-        from predictionio_tpu.data.store import PEventStore
-        from predictionio_tpu.parallel.reader import (
-            build_als_data_sharded,
-            store_coo_chunks,
-        )
+        from predictionio_tpu.models._streaming import build_streaming_als
 
         # the DATASOURCE's confidence scheme, applied in-stream (it rides
         # the handle: preparator params are a different DASE component)
         event_values = src.extras.get("event_values") or {
             n: 1.0 for n in src.event_names
         }
-        config = ALSConfig(
-            max_len=self.params.get_or("maxEventsPerUser", None),
-            buckets=self.params.get_or("buckets", 1),
-        )
-        mesh = ctx.mesh
-        source, users_enc, items_enc = store_coo_chunks(
-            storage.get_l_events(),
-            src.app_id,
-            channel_id=src.channel_id,
-            event_names=src.event_names,
-            chunk_rows=src.chunk_rows,
-            event_values=event_values,
-        )
-        als_data = build_als_data_sharded(
-            source, None, None, config, mesh,
-            model_shards=mesh.shape.get("model", 1),
+        users_enc, items_enc, als_data = build_streaming_als(
+            src, self.params, ctx.mesh, event_values=event_values
         )
         categories = _load_categories(src.app_name, src.channel_name)
         data = ECommerceData(
@@ -397,16 +377,9 @@ class ECommAlgorithm(TPUAlgorithm):
         (memoized per distinct user when the batch path passes a cache)."""
         if getattr(model, "seen_mode", "model") != "live":
             return model.seen.get(user_idx, set())
-        if cache is not None and user_idx in cache:
-            return cache[user_idx]
-        out = {
-            model.item_index[e.target_entity_id]
-            for e in live_target_events(model, str(query.get("user")))
-            if e.target_entity_id in model.item_index
-        }
-        if cache is not None:
-            cache[user_idx] = out
-        return out
+        from predictionio_tpu.models._streaming import live_seen_indices
+
+        return live_seen_indices(model, str(query.get("user")), cache)
 
     def _apply_rules(
         self,

@@ -53,6 +53,13 @@ class NCFModel:
     item_index: dict[str, int]
     seen: dict[int, set[int]]
     use_pallas: bool
+    #: "model": the trained-in seen map; "live": per-query event-store
+    #: read (O(entities) serving model; fresh interactions filter with no
+    #: retrain). Old pickles predate these; readers use getattr defaults.
+    seen_mode: str = "model"
+    app_name: str = ""
+    channel_name: str = None
+    event_names: list[str] = None
     #: lazily-built device-resident scorer (tables uploaded once); holds
     #: device buffers and a jit closure, so it must never be pickled into
     #: the model blob -- __getstate__ strips it and deploy rebuilds it via
@@ -174,12 +181,24 @@ class NCFAlgorithm(TPUAlgorithm):
             # so `pio train --resume` after preemption finds the crashed
             # attempt's epochs -- the round-1 instance-id key could not
             checkpoint = ctx.checkpoint_manager("ncf")
+        seen_mode = p.get_or("seenFilter", "model")
+        if seen_mode not in ("model", "live"):
+            # before the (expensive) training run, not after
+            raise ValueError(
+                f"seenFilter must be 'model' or 'live', got {seen_mode!r}"
+            )
         params, _ = train_ncf(
             config, users, items, labels, ctx.mesh, checkpoint=checkpoint
         )
+        if seen_mode == "live" and getattr(data, "eval_fold", False):
+            # a live read would -inf every held-out item (they still exist
+            # in the store) and zero eval metrics; fold data carries its
+            # train edges, so the trained-in map is correct there
+            seen_mode = "model"
         seen: dict[int, set[int]] = {}
-        for u, i in zip(data.users, data.items):
-            seen.setdefault(int(u), set()).add(int(i))
+        if seen_mode == "model":
+            for u, i in zip(data.users, data.items):
+                seen.setdefault(int(u), set()).add(int(i))
         backend = jax.devices()[0].platform
         return NCFModel(
             params=params,
@@ -188,6 +207,10 @@ class NCFAlgorithm(TPUAlgorithm):
             item_index={iid: j for j, iid in enumerate(data.item_ids)},
             seen=seen,
             use_pallas=p.get_or("usePallas", backend not in ("cpu",)),
+            seen_mode=seen_mode,
+            app_name=getattr(data, "app_name", ""),
+            channel_name=getattr(data, "channel_name", None),
+            event_names=getattr(data, "event_names", None),
         )
 
     def warm_up(self, model: NCFModel) -> None:
@@ -199,7 +222,16 @@ class NCFAlgorithm(TPUAlgorithm):
         model.batch_scorer()
 
     @staticmethod
-    def _topk_response(model: NCFModel, scores: np.ndarray, query, user_idx) -> dict:
+    def _seen(model: NCFModel, query, user_idx, cache=None) -> set[int]:
+        if getattr(model, "seen_mode", "model") != "live":
+            return model.seen.get(user_idx, set())
+        from predictionio_tpu.models._streaming import live_seen_indices
+
+        return live_seen_indices(model, str(query.get("user")), cache)
+
+    @staticmethod
+    def _topk_response(model: NCFModel, scores: np.ndarray, query, user_idx,
+                       seen_cache=None) -> dict:
         """Shared exclusion + ranking tail (predict and batch_predict must
         rank identically)."""
         exclude = {
@@ -208,7 +240,7 @@ class NCFAlgorithm(TPUAlgorithm):
             if str(b) in model.item_index
         }
         if query.get("unseenOnly", True):
-            exclude |= model.seen.get(user_idx, set())
+            exclude |= NCFAlgorithm._seen(model, query, user_idx, seen_cache)
         scores = scores.astype(np.float64)
         for j in exclude:
             scores[j] = -np.inf
@@ -235,13 +267,15 @@ class NCFAlgorithm(TPUAlgorithm):
             # pair budget caps only the on-device intermediates)
             rows_per_slice = score_buffer_rows(len(model.item_ids))
             scorer = model.batch_scorer()
+            seen_cache: dict = {}
             for start in range(0, len(user_rows), rows_per_slice):
                 part = user_rows[start : start + rows_per_slice]
                 scores = scorer(
                     np.fromiter((u for _, _, u in part), dtype=np.int32)
                 )
                 out.extend(
-                    (qid, self._topk_response(model, scores[row], q, user_idx))
+                    (qid, self._topk_response(model, scores[row], q, user_idx,
+                                              seen_cache=seen_cache))
                     for row, (qid, q, user_idx) in enumerate(part)
                 )
         out.extend((qid, self.predict(model, q)) for qid, q in fallback)

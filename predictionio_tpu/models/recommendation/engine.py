@@ -33,7 +33,6 @@ from predictionio_tpu.models._als_common import (
 )
 from predictionio_tpu.models._streaming import (
     StreamingHandle,
-    live_target_events,
     streaming_handle_or_none,
 )
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
@@ -182,28 +181,10 @@ class RecommendationPreparator(Preparator):
         return training_data, als_data
 
     def _prepare_streaming(self, ctx, src: StreamingRatings):
-        from predictionio_tpu.data import storage
-        from predictionio_tpu.parallel.reader import (
-            build_als_data_sharded,
-            store_coo_chunks,
-        )
+        from predictionio_tpu.models._streaming import build_streaming_als
 
-        config = ALSConfig(
-            max_len=self.params.get_or("maxEventsPerUser", None),
-            buckets=self.params.get_or("buckets", 1),
-        )
-        mesh = ctx.mesh
-        source, users_enc, items_enc = store_coo_chunks(
-            storage.get_l_events(),
-            src.app_id,
-            channel_id=src.channel_id,
-            event_names=src.event_names,
-            rating_key=src.rating_key,
-            chunk_rows=src.chunk_rows,
-        )
-        als_data = build_als_data_sharded(
-            source, None, None, config, mesh,
-            model_shards=mesh.shape.get("model", 1),
+        users_enc, items_enc, als_data = build_streaming_als(
+            src, self.params, ctx.mesh
         )
         # vocabularies materialized by the scan; edge arrays stay empty --
         # the whole point of the streaming path
@@ -246,7 +227,8 @@ class RecommendationModel:
     channel_name: str = None
 
 
-def _seen_indices(model: "RecommendationModel", query, user_idx: int) -> set[int]:
+def _seen_indices(model: "RecommendationModel", query, user_idx: int,
+                  cache: dict | None = None) -> set[int]:
     """The user's already-interacted item indices for the unseenOnly filter.
 
     "model" mode reads the trained-in seen map. "live" mode queries the
@@ -259,11 +241,9 @@ def _seen_indices(model: "RecommendationModel", query, user_idx: int) -> set[int
     """
     if getattr(model, "seen_mode", "model") != "live":
         return model.seen.get(user_idx, set())
-    return {
-        model.item_index[e.target_entity_id]
-        for e in live_target_events(model, str(query.get("user")))
-        if e.target_entity_id in model.item_index
-    }
+    from predictionio_tpu.models._streaming import live_seen_indices
+
+    return live_seen_indices(model, str(query.get("user")), cache)
 
 
 class ALSAlgorithm(TPUAlgorithm):
@@ -367,12 +347,10 @@ class ALSAlgorithm(TPUAlgorithm):
         # whole bulk run, not one per row (the scoring itself is still a
         # single matmul; batch-heavy deployments preferring zero lookups
         # should train with seenFilter "model")
-        seen_memo: dict[int, set[int]] = {}
+        seen_memo: dict = {}
 
         def seen_for(q, user_idx):
-            if user_idx not in seen_memo:
-                seen_memo[user_idx] = _seen_indices(model, q, user_idx)
-            return seen_memo[user_idx]
+            return _seen_indices(model, q, user_idx, cache=seen_memo)
 
         out = batch_score_known_users(
             model.als,

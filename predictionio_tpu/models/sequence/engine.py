@@ -46,6 +46,10 @@ class SequencesData(SanityCheck):
     sequences: list[np.ndarray]
     user_ids: list[str]
     item_ids: list[str]
+    #: carried for serving-time live history reads (historyMode "live")
+    app_name: str = ""
+    channel_name: str = None
+    event_names: list[str] = None
 
     def sanity_check(self) -> None:
         if not self.sequences:
@@ -92,6 +96,11 @@ class SequenceDataSource(DataSource):
             sequences=sequences,
             user_ids=seq_user_ids,
             item_ids=ds.target_entity_id_vocab,
+            app_name=self.params.appName,
+            channel_name=self.params.get_or("channelName", None),
+            event_names=self.params.get_or(
+                "eventNames", ["view", "buy", "rate"]
+            ),
         )
 
     def read_training(self, ctx) -> SequencesData:
@@ -163,6 +172,15 @@ class SASRecModel:
     item_ids: list[str]
     item_index: dict[str, int]
     histories: dict[str, np.ndarray]   # user id -> shifted (+1) id sequence
+    #: "model": queries continue the TRAINED-IN history above; "live":
+    #: per-query event-store read -- session-based serving: events
+    #: ingested after training extend the sequence the model continues,
+    #: with no retrain, and the model stays O(entities). Old pickles
+    #: predate these fields; readers use getattr defaults.
+    history_mode: str = "model"
+    app_name: str = ""
+    channel_name: str = None
+    event_names: list[str] = None
 
 
 class SASRecAlgorithm(TPUAlgorithm):
@@ -196,8 +214,15 @@ class SASRecAlgorithm(TPUAlgorithm):
             seq_parallel=p.get_or("seqParallel", "ring"),
             attention=p.get_or("attention", "auto"),
         )
+        history_mode = self.params.get_or("historyMode", "model")
+        if history_mode not in ("model", "live"):
+            # before the (expensive) training run, not after
+            raise ValueError(
+                f"historyMode must be 'model' or 'live', got {history_mode!r}"
+            )
         params, _ = train_sasrec(config, prepared.matrix, ctx.mesh)
-        histories = {
+        # live mode: O(entities) model; queries read fresh histories
+        histories = {} if history_mode == "live" else {
             uid: seq + 1 for uid, seq in zip(data.user_ids, data.sequences)
         }
         return SASRecModel(
@@ -206,6 +231,10 @@ class SASRecAlgorithm(TPUAlgorithm):
             item_ids=data.item_ids,
             item_index={iid: j for j, iid in enumerate(data.item_ids)},
             histories=histories,
+            history_mode=history_mode,
+            app_name=data.app_name,
+            channel_name=data.channel_name,
+            event_names=data.event_names,
         )
 
     @staticmethod
@@ -221,7 +250,26 @@ class SASRecAlgorithm(TPUAlgorithm):
                 ],
                 np.int32,
             )
-        return model.histories.get(str(query.get("user")))
+        user = str(query.get("user"))
+        if getattr(model, "history_mode", "model") != "live":
+            return model.histories.get(user)
+        from predictionio_tpu.models._streaming import live_target_events
+
+        # time-ASCENDING: the sequence the model continues; keep the tail
+        events = sorted(
+            live_target_events(model, user), key=lambda e: e.event_time
+        )
+        seq = [
+            model.item_index[e.target_entity_id] + 1
+            for e in events
+            if e.target_entity_id in model.item_index
+        ]
+        if not seq:
+            return None
+        # FULL history, untruncated: the unseenOnly exclusion must cover
+        # everything the user saw (model mode passes full sequences too);
+        # the scorer itself keeps only the max_len tail
+        return np.asarray(seq, np.int32)
 
     @staticmethod
     def _topk_response(model: SASRecModel, scores: np.ndarray, query, prefix) -> dict:

@@ -288,3 +288,54 @@ class TestNCFEngine:
         assert deployed[0]._batch_scorer is not None  # batchpredict path too
         # and the blob round-trip stripped it (no device buffers pickled)
         assert pickle.loads(pickle.dumps(models[0]))._scorer is None
+
+
+class TestLiveSeenFilter:
+    def test_live_filter_agrees_and_sees_fresh_events(self, storage_env):
+        """seenFilter "live": the NCF model carries no O(edges) seen map;
+        unseenOnly resolves per query from the store, so a fresh rating
+        filters with no retrain."""
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.models.ncf import engine_factory
+        from predictionio_tpu.workflow.context import RuntimeContext
+
+        app_id = storage_env.get_meta_data_apps().insert(App(name="NcfLive"))
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        rng = np.random.default_rng(5)
+        le.batch_insert(
+            [
+                Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({"rating": float(rng.integers(1, 6))}))
+                for u in range(12) for i in range(10) if rng.random() < 0.5
+            ],
+            app_id=app_id,
+        )
+        ep = EngineParams.from_json_obj(
+            {"datasource": {"params": {"appName": "NcfLive"}},
+             "algorithms": [{"name": "ncf", "params": {
+                 "embedDim": 4, "hidden": [8, 4], "epochs": 2,
+                 "batchSize": 16, "seenFilter": "live"}}]}
+        )
+        engine = engine_factory()
+        model = engine.train(RuntimeContext(), ep)[0]
+        assert model.seen == {} and model.seen_mode == "live"
+        a = engine._algorithms(ep)[0]
+        out = a.predict(model, {"user": "u0", "num": 10})
+        served = {s["item"] for s in out["itemScores"]}
+        rated = {e.target_entity_id
+                 for e in le.find(app_id=app_id, entity_id="u0")}
+        assert not (served & rated)
+        # fresh event filters immediately
+        fresh = next(i for i in served)
+        le.insert(
+            Event(event="rate", entity_type="user", entity_id="u0",
+                  target_entity_type="item", target_entity_id=fresh,
+                  properties=DataMap({"rating": 5.0})),
+            app_id=app_id,
+        )
+        after = a.predict(model, {"user": "u0", "num": 10})
+        assert fresh not in {s["item"] for s in after["itemScores"]}

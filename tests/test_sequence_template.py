@@ -217,3 +217,58 @@ class TestSASRecBatchPredict:
             )
         assert batched[2] == {"itemScores": []}
         assert "i0" not in {s["item"] for s in batched[4]["itemScores"]}
+
+
+class TestLiveHistory:
+    def test_live_history_serves_fresh_sessions(self, storage_env):
+        """historyMode "live": SASRec continues the user's CURRENT store
+        history -- an event ingested after training changes the sequence
+        the model continues, with no retrain, and the model carries no
+        O(edges) history map."""
+        import datetime as dt
+
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.models.sequence import engine_factory
+        from predictionio_tpu.workflow.context import RuntimeContext
+
+        app_id = storage_env.get_meta_data_apps().insert(App(name="SeqLive"))
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        base = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+        rng = np.random.default_rng(2)
+        events = []
+        k = 0
+        for u in range(10):
+            for i in rng.permutation(8)[:4]:
+                events.append(
+                    Event(event="view", entity_type="user", entity_id=f"u{u}",
+                          target_entity_type="item", target_entity_id=f"i{i}",
+                          event_time=base + dt.timedelta(seconds=k))
+                )
+                k += 1
+        le.batch_insert(events, app_id=app_id)
+        ep = EngineParams.from_json_obj(
+            {"datasource": {"params": {"appName": "SeqLive"}},
+             "preparator": {"params": {"maxLen": 8}},
+             "algorithms": [{"name": "sasrec", "params": {
+                 "embedDim": 8, "numHeads": 2, "numBlocks": 1, "ffnDim": 16,
+                 "epochs": 2, "batchSize": 8, "historyMode": "live"}}]}
+        )
+        engine = engine_factory()
+        model = engine.train(RuntimeContext(), ep)[0]
+        assert model.histories == {} and model.history_mode == "live"
+        a = engine._algorithms(ep)[0]
+        out = a.predict(model, {"user": "u0", "num": 3})
+        assert out["itemScores"]
+        # a NEW user with a fresh session gets predictions with no retrain
+        assert a.predict(model, {"user": "brand_new"}) == {"itemScores": []}
+        le.insert(
+            Event(event="view", entity_type="user", entity_id="brand_new",
+                  target_entity_type="item", target_entity_id="i3",
+                  event_time=base + dt.timedelta(hours=1)),
+            app_id=app_id,
+        )
+        fresh = a.predict(model, {"user": "brand_new", "num": 3})
+        assert fresh["itemScores"], "fresh session did not serve"
