@@ -328,10 +328,43 @@ def secondary_main(result_path: str) -> None:
             "users": users, "items": items, "config": "#5 NCF batchpredict",
         }
 
+    def serving_qps():
+        """#6: query-server QPS under concurrent load, micro-batching off
+        vs on. CPU-only by design (the serving path is host+single-chip);
+        on the TPU secondary child the backend is already initialized by
+        the earlier phases, so a CPU pin could not take effect -- skip
+        rather than report a number measured against the TPU tunnel.
+        Sizes are trimmed to fit the secondary budget; the full-size A/B
+        is `python -m predictionio_tpu.tools.serving_bench`."""
+        if tpu:
+            return {
+                "skipped": "CPU-only phase (TPU child shares an already-"
+                "initialized backend)"
+            }
+        from predictionio_tpu.tools.serving_bench import run_ab
+
+        rep = run_ab(
+            "recommendation",
+            concurrency=16,
+            requests=480,
+            users=300,
+            items=30_000,
+            events=60_000,
+        )
+        return {
+            "qps_batching_off": rep["batching_off"]["qps"],
+            "qps_batching_on": rep["batching_on"]["qps"],
+            "p50_ms_batching_on": rep["batching_on"]["p50_ms"],
+            "qps_speedup": rep["qps_speedup"],
+            "responses_equivalent": rep["responses_equivalent"],
+            "config": "#6 serving_qps (16 clients, 30k items, rank 64)",
+        }
+
     phase("naive_bayes_fit", nb_fit)
     phase("logreg_lbfgs_fit", logreg_fit)
     phase("cooccurrence_llr_indicators", cooc_indicators)
     phase("ncf_batchpredict", ncf_batchpredict)
+    phase("serving_qps", serving_qps)
 
 
 def child_main(mode: str, result_path: str) -> None:

@@ -177,6 +177,21 @@ class Serving(Component, Generic[Q, P]):
     @abc.abstractmethod
     def serve(self, query: Q, predictions: Sequence[P]) -> P: ...
 
+    def serve_batch(
+        self, queries: Sequence[Q], predictions: Sequence[Sequence[P]]
+    ) -> list[P]:
+        """Combine per-algorithm predictions for a whole micro-batch.
+
+        ``predictions[i]`` holds query ``i``'s per-algorithm predictions
+        (same shape ``serve`` receives). Default: loop ``serve``. Override
+        when the combination itself vectorizes; the query server falls
+        back to per-query ``serve`` if this raises, so an override only
+        needs to handle the all-good path.
+        """
+        return [
+            self.serve(q, preds) for q, preds in zip(queries, predictions)
+        ]
+
 
 class PersistentModel(abc.ABC):
     """User-managed model persistence (reference PersistentModel[+Loader]).

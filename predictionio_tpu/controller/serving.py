@@ -16,6 +16,13 @@ class FirstServing(Serving):
             raise ValueError("FirstServing received no predictions")
         return predictions[0]
 
+    def serve_batch(self, queries, predictions: Sequence[Sequence]):
+        # the dominant combinator on the serving hot path: one list
+        # comprehension for the whole micro-batch, no per-query dispatch
+        if any(not p for p in predictions):
+            raise ValueError("FirstServing received no predictions")
+        return [p[0] for p in predictions]
+
 
 class AverageServing(Serving):
     """Average numeric predictions across algorithms.

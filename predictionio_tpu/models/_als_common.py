@@ -157,14 +157,31 @@ def batch_score_known_users(als_model: ALSModel, user_rows, respond) -> list:
     return out
 
 
+def topk_order(scores: np.ndarray, num: int) -> np.ndarray:
+    """Indices of the top-``num`` scores, descending (stable tie order).
+
+    Selection is O(items) argpartition + O(num log num) sort instead of a
+    full O(items log items) argsort: this runs once PER REQUEST on the
+    serving hot path, and at large catalogs it is what the batched
+    scorer's amortized matmul would otherwise hide behind. NaN/-inf
+    sentinels partition to the tail exactly as they sort. ONE definition
+    for every template's ranking tail -- batched and unbatched responses
+    must tie-break identically.
+    """
+    n = scores.shape[0]
+    if 0 < num < n:
+        cand = np.argpartition(-scores, num - 1)[:num]
+        return cand[np.argsort(-scores[cand], kind="stable")]
+    return np.argsort(-scores, kind="stable")[:num]
+
+
 def topk_item_scores(item_ids: list[str], scores: np.ndarray, num: int) -> dict:
     """Rank + format tail shared by every template response: descending
     top-``num``, excluded entries carried as -inf and dropped here."""
-    order = np.argsort(-scores)[:num]
     return {
         "itemScores": [
             {"item": item_ids[j], "score": float(scores[j])}
-            for j in order
+            for j in topk_order(scores, num)
             if np.isfinite(scores[j])
         ]
     }

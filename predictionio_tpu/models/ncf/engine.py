@@ -35,13 +35,26 @@ from predictionio_tpu.controller.base import Preparator
 
 
 #: guards first-query scorer construction across serving threads
-_SCORER_BUILD_LOCK = threading.Lock()
+#: (reentrant: scorer() builds through batch_scorer() under the same lock)
+_SCORER_BUILD_LOCK = threading.RLock()
 
 
 class NCFPreparator(Preparator):
     """NCF consumes the COO directly; no CSR packing needed."""
 
     def prepare(self, ctx, training_data: RatingsData):
+        from predictionio_tpu.models._streaming import StreamingHandle
+
+        if isinstance(training_data, StreamingHandle):
+            # NCF shares RecommendationDataSource, whose '"reader":
+            # "streaming"' mode hands back a handle with no edge arrays;
+            # NCF's SGD needs the materialized COO. Fail here with the
+            # template named instead of an opaque AttributeError downstream.
+            raise ValueError(
+                "the NCF template does not support the streaming sharded "
+                'reader; remove "reader": "streaming" from the datasource '
+                "params (NCF training consumes the materialized COO arrays)"
+            )
         return training_data
 
 
@@ -133,10 +146,34 @@ class NCFModel:
                     if self.use_pallas:
                         self._scorer = self._pallas_with_fallback()
                     else:
-                        n = len(self.item_ids)
-                        self._scorer = lambda u: reference_score_all_items(
-                            self.params, u, n
-                        )
+                        # route single queries through the SAME jitted
+                        # program family the micro-batched path uses
+                        # (bucket of 1): batched and unbatched serving
+                        # answers stay numerically identical, and a lone
+                        # query still beats the numpy reference walk
+                        try:
+                            batch = self.batch_scorer()
+                            self._scorer = lambda u: batch(
+                                np.asarray([u], np.int32)
+                            )[0]
+                        except Exception:
+                            # the fallback serves, but batched and single
+                            # answers are no longer the same program --
+                            # say so, or the identity loss is undebuggable
+                            import logging
+
+                            logging.getLogger("pio.ncf").warning(
+                                "batch scorer build failed; single-query "
+                                "serving falls back to the numpy reference "
+                                "path (batched/unbatched responses may "
+                                "differ at float precision)", exc_info=True,
+                            )
+                            n = len(self.item_ids)
+                            self._scorer = (
+                                lambda u: reference_score_all_items(
+                                    self.params, u, n
+                                )
+                            )
         return self._scorer
 
     def batch_scorer(self):

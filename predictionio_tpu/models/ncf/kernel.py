@@ -199,14 +199,25 @@ def make_batch_scorer(params, num_items: int, pair_budget: int = 2_000_000):
 
     chunk = max(1, pair_budget // max(num_items, 1))
 
+    def bucket(n: int) -> int:
+        # pad ragged calls to the next power of two, not to the full
+        # chunk: offline bulk runs still see the one big chunk shape, but
+        # a serving micro-batch of 16 must not pay a 400-row program.
+        # Compiled-shape count stays bounded at log2(chunk).
+        b = 1
+        while b < n:
+            b <<= 1
+        return min(b, chunk)
+
     def scores(user_indices) -> np.ndarray:
         user_indices = np.asarray(user_indices, np.int32)
         out = np.empty((user_indices.size, num_items), np.float32)
         for start in range(0, user_indices.size, chunk):
             part = user_indices[start : start + chunk]
             n = part.size
-            if n < chunk:  # pad the ragged tail: one compiled shape total
-                part = np.pad(part, (0, chunk - n))
+            pad = bucket(n)
+            if n < pad:
+                part = np.pad(part, (0, pad - n))
             out[start : start + n] = np.asarray(
                 chunk_scores(jnp.asarray(part))
             )[:n]

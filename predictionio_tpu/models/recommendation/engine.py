@@ -321,6 +321,10 @@ class ALSAlgorithm(TPUAlgorithm):
             seen_mode=seen_mode,
             app_name=ratings_data.app_name,
             event_names=ratings_data.event_names,
+            # without this, a streaming build on a non-default channel
+            # serves live seen-filter lookups against the DEFAULT channel
+            # (finds nothing, silently stops excluding seen items)
+            channel_name=getattr(ratings_data, "channel_name", None),
         )
 
     def warm_up(self, model: RecommendationModel) -> None:

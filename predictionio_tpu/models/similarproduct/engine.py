@@ -25,6 +25,7 @@ from predictionio_tpu.ops.cooccurrence import (
     cooccurrence_indicators,
     distinct_user_counts,
 )
+from predictionio_tpu.models._als_common import topk_order
 from predictionio_tpu.models._streaming import (
     StreamingHandle,
     live_target_events,
@@ -225,38 +226,112 @@ class CooccurrenceAlgorithm(TPUAlgorithm):
             event_names=list(data.event_names) if streamed else None,
         )
 
-    def predict(self, model: SimilarityModel, query) -> dict:
-        num = int(query.get("num", 10))
+    @staticmethod
+    def _resolve_anchors(model: SimilarityModel, query) -> list[int]:
         if "items" in query:
-            anchors = [
+            return [
                 model.item_index[str(i)]
                 for i in query["items"]
                 if str(i) in model.item_index
             ]
-        elif "user" in query:
-            anchors = _user_anchor_items(model, str(query["user"]))
-        else:
-            raise ValueError("query must contain 'items' or 'user'")
-        if not anchors:
-            return {"itemScores": []}
-        scores: dict[int, float] = {}
-        for a in anchors:
-            for j, v in zip(model.top_indices[a], model.top_values[a]):
-                if v > 0:
-                    scores[int(j)] = scores.get(int(j), 0.0) + float(v)
+        if "user" in query:
+            return _user_anchor_items(model, str(query["user"]))
+        raise ValueError("query must contain 'items' or 'user'")
+
+    @staticmethod
+    def _anchor_contributions(model: SimilarityModel, anchors: list[int]):
+        """(cols, vals): the anchors' positive indicator entries, flattened
+        -- one gather over the [items, k] tables instead of a python loop
+        over every (anchor, k) pair."""
+        idx = model.top_indices[anchors].ravel()
+        vals = model.top_values[anchors].ravel().astype(np.float64)
+        keep = vals > 0
+        return idx[keep], vals[keep]
+
+    @staticmethod
+    def _topk_response(model: SimilarityModel, scores: np.ndarray, query,
+                       anchors: list[int]) -> dict:
+        """Shared exclusion + ranking tail (predict and batch_predict must
+        rank identically). The exclusion sentinel here is 0, not -inf:
+        only positively-scored items are ever emitted."""
+        scores = scores.copy()
         exclude = set(anchors)
         for b in query.get("blackList") or []:
             if str(b) in model.item_index:
                 exclude.add(model.item_index[str(b)])
-        ranked = sorted(
-            ((j, s) for j, s in scores.items() if j not in exclude),
-            key=lambda kv: -kv[1],
-        )[:num]
+        for j in exclude:
+            scores[j] = 0.0
+        order = topk_order(scores, int(query.get("num", 10)))
         return {
             "itemScores": [
-                {"item": model.item_ids[j], "score": s} for j, s in ranked
+                {"item": model.item_ids[int(j)], "score": float(scores[j])}
+                for j in order
+                if scores[j] > 0
             ]
         }
+
+    def predict(self, model: SimilarityModel, query) -> dict:
+        anchors = self._resolve_anchors(model, query)
+        if not anchors:
+            return {"itemScores": []}
+        scores = np.zeros(len(model.item_ids), np.float64)
+        cols, vals = self._anchor_contributions(model, anchors)
+        np.add.at(scores, cols, vals)
+        return self._topk_response(model, scores, query, anchors)
+
+    def batch_predict(self, model: SimilarityModel, queries):
+        """Vectorized bulk scoring: the whole batch's anchor contributions
+        accumulate into ONE [B, items] buffer with a single scatter-add
+        (memory-bounded slices), instead of a python dict walk per query.
+        Live user-anchor lookups are memoized per distinct user for the
+        batch. Cold queries answer empty; malformed queries raise
+        predict()'s normal error through the fallback loop."""
+        from predictionio_tpu.models._als_common import score_buffer_rows
+
+        resolved, out, fallback = [], [], []
+        live_memo: dict[str, list[int]] = {}
+        for qid, q in queries:
+            if not isinstance(q, dict) or not ("items" in q or "user" in q):
+                fallback.append((qid, q))
+                continue
+            if "items" not in q and getattr(model, "history_mode", "model") == "live":
+                user = str(q["user"])
+                if user not in live_memo:
+                    live_memo[user] = _user_anchor_items(model, user)
+                anchors = live_memo[user]
+            else:
+                anchors = self._resolve_anchors(model, q)
+            if not anchors:
+                out.append((qid, {"itemScores": []}))
+            else:
+                resolved.append((qid, q, anchors))
+        # malformed queries raise predict()'s error BEFORE the vectorized
+        # work: one bad query must not cost the batch its completed scoring
+        out.extend((qid, self.predict(model, q)) for qid, q in fallback)
+        n_items = len(model.item_ids)
+        # halved: this buffer accumulates in f64 (predict's dtype -- the
+        # batched and single paths must sum identically) while
+        # score_buffer_rows budgets for f32
+        rows_per_slice = max(1, score_buffer_rows(n_items) // 2)
+        for start in range(0, len(resolved), rows_per_slice):
+            part = resolved[start : start + rows_per_slice]
+            scores = np.zeros((len(part), n_items), np.float64)
+            row_ids, col_ids, vals = [], [], []
+            for row, (_, _, anchors) in enumerate(part):
+                cols, v = self._anchor_contributions(model, anchors)
+                row_ids.append(np.full(cols.size, row, np.int64))
+                col_ids.append(cols)
+                vals.append(v)
+            np.add.at(
+                scores,
+                (np.concatenate(row_ids), np.concatenate(col_ids)),
+                np.concatenate(vals),
+            )
+            out.extend(
+                (qid, self._topk_response(model, scores[row], q, anchors))
+                for row, (qid, q, anchors) in enumerate(part)
+            )
+        return out
 
 
 def engine_factory() -> Engine:

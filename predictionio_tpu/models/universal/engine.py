@@ -27,6 +27,7 @@ from predictionio_tpu.ops.ragged import pack_padded_csr
 
 import logging
 
+from predictionio_tpu.models._als_common import topk_order
 from predictionio_tpu.models._streaming import (
     StreamingHandle,
     live_target_events,
@@ -342,9 +343,52 @@ class URAlgorithm(TPUAlgorithm):
             channel_name=src.channel_name,
         )
 
-    def predict(self, model: URModel, query) -> dict:
+    @staticmethod
+    def _rule_multiplier(model: URModel, rule, cache: dict | None) -> np.ndarray:
+        """One ``fields`` rule's per-item multiplier. The match scan is
+        O(items) of python property probing -- by far the dominant cost of
+        a rule-carrying query -- so batch_predict memoizes it per DISTINCT
+        rule across the whole batch."""
+        name, values = rule.get("name"), set(map(str, rule.get("values", [])))
+        bias = float(rule.get("bias", -1))
+        key = (name, tuple(sorted(values)), bias)
+        if cache is not None and key in cache:
+            return cache[key]
+        matches = np.array(
+            [
+                str(model.item_properties.get(iid, {}).get(name)) in values
+                or bool(
+                    isinstance(model.item_properties.get(iid, {}).get(name), list)
+                    and values
+                    & set(map(str, model.item_properties[iid][name]))
+                )
+                for iid in model.item_ids
+            ]
+        )
+        mult = (
+            np.where(matches, 1.0, 0.0)
+            if bias < 0
+            else np.where(matches, bias, 1.0)
+        )
+        if cache is not None:
+            cache[key] = mult
+        return mult
+
+    def _predict_impl(
+        self,
+        model: URModel,
+        query,
+        rule_cache: dict | None = None,
+        history_memo: dict | None = None,
+    ) -> dict:
         num = int(query.get("num", 10))
-        history = _user_history(model, str(query.get("user", "")))
+        user = str(query.get("user", ""))
+        if history_memo is not None:
+            if user not in history_memo:
+                history_memo[user] = _user_history(model, user)
+            history = dict(history_memo[user])  # copied before any mutation
+        else:
+            history = _user_history(model, user)
         # item-anchored queries act as view-history of the primary type
         if "items" in query:
             anchors = [
@@ -378,27 +422,11 @@ class URAlgorithm(TPUAlgorithm):
         # business rules: fields filters/boosts over item properties
         multipliers = np.ones(len(model.item_ids))
         for rule in query.get("fields") or []:
-            name, values = rule.get("name"), set(map(str, rule.get("values", [])))
-            bias = float(rule.get("bias", -1))
-            matches = np.array(
-                [
-                    str(model.item_properties.get(iid, {}).get(name)) in values
-                    or bool(
-                        isinstance(model.item_properties.get(iid, {}).get(name), list)
-                        and values
-                        & set(map(str, model.item_properties[iid][name]))
-                    )
-                    for iid in model.item_ids
-                ]
-            )
-            if bias < 0:
-                multipliers *= np.where(matches, 1.0, 0.0)
-            else:
-                multipliers *= np.where(matches, bias, 1.0)
+            multipliers *= self._rule_multiplier(model, rule, rule_cache)
         scores = scores * multipliers
         for j in exclude:
             scores[j] = 0.0
-        order = np.argsort(-scores)[:num]
+        order = topk_order(scores, num)
         return {
             "itemScores": [
                 {"item": model.item_ids[j], "score": float(scores[j])}
@@ -406,6 +434,23 @@ class URAlgorithm(TPUAlgorithm):
                 if scores[j] > 0
             ]
         }
+
+    def predict(self, model: URModel, query) -> dict:
+        return self._predict_impl(model, query)
+
+    def batch_predict(self, model: URModel, queries):
+        """Bulk scoring with per-batch memoization: business-rule match
+        masks are built ONCE per distinct rule (they cost an O(items)
+        python property scan each) and live user-history reads once per
+        distinct user, instead of once per query. Scoring itself stays the
+        reverse-index walk (already O(history * hits), not O(items));
+        malformed queries raise predict()'s normal error."""
+        rule_cache: dict = {}
+        history_memo: dict = {}
+        return [
+            (qid, self._predict_impl(model, q, rule_cache, history_memo))
+            for qid, q in queries
+        ]
 
 
 def engine_factory() -> Engine:

@@ -748,8 +748,16 @@ def als_fit(
         m = mesh.shape["model"]
         d = mesh.shape["data"]
         for side, name in ((data.by_row, "user"), (data.by_col, "item")):
+            # sides built by the sharded reader hold only this process's
+            # rows in their blocks; the divisibility guarantee (and the
+            # device array shape) is on the GLOBAL per-bucket row counts
+            rows_per_bucket = (
+                side.global_rows
+                if side.global_rows is not None
+                else tuple(b.indices.shape[0] for b in side.blocks)
+            )
             if side.total_slots % m or any(
-                b.indices.shape[0] % (d * m) for b in side.blocks
+                rows % (d * m) for rows in rows_per_bucket
             ):
                 raise ValueError(
                     f"factor_sharding='model' needs every {name} bucket's "

@@ -46,6 +46,20 @@ def register(sub: argparse._SubParsersAction) -> None:
     # python analogue of the reference's --key-store TLS option
     deploy.add_argument("--ssl-cert", default=None, help="PEM cert: serve HTTPS")
     deploy.add_argument("--ssl-key", default=None, help="PEM key (if not in cert)")
+    deploy.add_argument(
+        "--batch-window-ms", type=float, default=2.0,
+        help="micro-batching latency deadline: how long a query may wait "
+        "for batchmates (0 disables batching)",
+    )
+    deploy.add_argument(
+        "--max-batch-size", type=int, default=64,
+        help="micro-batching flush size (1 disables batching)",
+    )
+    deploy.add_argument(
+        "--batch-buckets", default="1,4,16,64,128",
+        help="comma-separated padded batch shapes; jitted scorers compile "
+        "once per bucket",
+    )
     deploy.set_defaults(func=cmd_deploy)
 
     undeploy = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -105,6 +119,7 @@ def cmd_deploy(args: argparse.Namespace) -> int:
         FeedbackConfig,
         run_query_server,
     )
+    from predictionio_tpu.workflow.microbatch import BatchConfig
 
     variant = _load_variant(args)
     feedback = None
@@ -116,6 +131,15 @@ def cmd_deploy(args: argparse.Namespace) -> int:
             ),
             access_key=args.accesskey,
         )
+    try:
+        buckets = tuple(
+            int(b) for b in args.batch_buckets.split(",") if b.strip()
+        )
+    except ValueError:
+        raise SystemExit(
+            f"Error: --batch-buckets must be comma-separated integers, "
+            f"got {args.batch_buckets!r}"
+        )
     run_query_server(
         variant,
         host=args.ip,
@@ -124,6 +148,11 @@ def cmd_deploy(args: argparse.Namespace) -> int:
         feedback=feedback,
         ssl_cert=args.ssl_cert,
         ssl_key=args.ssl_key,
+        batching=BatchConfig(
+            max_batch_size=args.max_batch_size,
+            window_ms=args.batch_window_ms,
+            buckets=buckets,
+        ),
     )
     return 0
 

@@ -8,10 +8,20 @@ threads, each with its own persistent HTTP connection, and reports the
 latency distribution plus aggregate throughput:
 
     python -m predictionio_tpu.tools.serving_bench \
-        --url http://127.0.0.1:8000 --clients 8 --requests 400 \
+        --url http://127.0.0.1:8000 --concurrency 8 --requests 400 \
         --query '{"user": "u1", "num": 4}'
 
-Prints one JSON line; also importable (``run_load``) for tests.
+Without ``--url`` it runs the **self-contained micro-batching A/B**: a
+synthetic catalog is ingested into a throwaway store, the named engine(s)
+are trained, and the same concurrent load is driven against two local
+servers -- micro-batching disabled vs enabled -- reporting both QPS /
+latency distributions and the speedup:
+
+    JAX_PLATFORMS=cpu python -m predictionio_tpu.tools.serving_bench \
+        --concurrency 32 --engine both
+
+Prints one JSON line; also importable (``run_load`` / ``run_ab``) for
+tests and ``bench.py``.
 """
 
 from __future__ import annotations
@@ -109,18 +119,341 @@ def run_load(
     }
 
 
+# --------------------------------------------------------------------------
+# self-contained micro-batching A/B
+# --------------------------------------------------------------------------
+
+#: engines the A/B knows how to train on a synthetic rating stream; params
+#: and catalog sizes target the regime micro-batching exists for (scoring
+#: cost comparable to or above the per-request HTTP stack cost); training
+#: quality is not the point -- few iterations/epochs, serving-shaped catalog
+AB_ENGINES: dict[str, dict] = {
+    "recommendation": {
+        "factory": "predictionio_tpu.models.recommendation.engine.engine_factory",
+        "algorithms": [
+            {
+                "name": "als",
+                "params": {
+                    "rank": 64,
+                    "numIterations": 2,
+                    "checkpointInterval": 0,
+                },
+            }
+        ],
+        # per-query serving cost is one [items, rank] gemv (a full factor-
+        # matrix scan); the batched arm amortizes that scan across the batch
+        "defaults": {"users": 500, "items": 100_000, "events": 150_000},
+    },
+    "ncf": {
+        "factory": "predictionio_tpu.models.ncf.engine.engine_factory",
+        "algorithms": [
+            {
+                "name": "ncf",
+                "params": {
+                    "embedDim": 16,
+                    "hidden": [32, 16],
+                    "epochs": 1,
+                    "usePallas": False,
+                    "checkpoint": False,
+                },
+            }
+        ],
+        # NCF scores ALL items per query, so compute does not amortize with
+        # batch size on CPU (it does on an accelerator, where the batch is
+        # one device program); the CPU win is dispatch amortization, which
+        # dominates at small catalogs and inverts past ~8k items
+        "defaults": {"users": 500, "items": 4_000, "events": 30_000},
+    },
+}
+
+
+def _responses_equivalent(a: bytes, b: bytes, rtol: float = 1e-5) -> bool:
+    """Same ranking, scores equal up to float accumulation order.
+
+    The ALS templates score a single query with a gemv and a batch with a
+    multi-row gemm; BLAS accumulates those in different orders, so scores
+    can drift at the ulp level (the same accepted semantic as
+    ``batch_predict`` vs ``predict`` -- see test_ncf's batch contract).
+    Item identity and order must still match exactly.
+    """
+    if a == b:
+        return True
+    try:
+        ja, jb = json.loads(a), json.loads(b)
+    except ValueError:
+        return False
+    sa, sb = ja.get("itemScores"), jb.get("itemScores")
+    if not isinstance(sa, list) or not isinstance(sb, list):
+        return ja == jb
+    if [x.get("item") for x in sa] != [x.get("item") for x in sb]:
+        return False
+    import math
+
+    return all(
+        math.isclose(x["score"], y["score"], rel_tol=rtol, abs_tol=1e-8)
+        for x, y in zip(sa, sb)
+    )
+
+
+def _ingest_synthetic(app_name: str, users: int, items: int, events: int):
+    """Synthetic rating stream: zipf-ish item popularity, every item
+    guaranteed at least one event (the vocab must span the catalog)."""
+    import numpy as np
+
+    from predictionio_tpu.data import DataMap, Event, storage
+    from predictionio_tpu.data.storage.base import App
+
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(name=app_name))
+    le = storage.get_l_events()
+    le.init_channel(app_id)
+    rng = np.random.default_rng(7)
+    events = max(events, items)  # coverage needs one event per item
+    uu = rng.integers(0, users, size=events)
+    ii = (np.minimum(rng.random(events) ** 2.0, 0.999999) * items).astype(int)
+    ii[:items] = np.arange(items)  # full catalog coverage
+    rr = rng.integers(1, 6, size=events)
+    le.batch_insert(
+        [
+            Event(
+                event="rate",
+                entity_type="user",
+                entity_id=f"u{int(u)}",
+                target_entity_type="item",
+                target_entity_id=f"i{int(i)}",
+                properties=DataMap({"rating": float(r)}),
+            )
+            for u, i, r in zip(uu, ii, rr)
+        ],
+        app_id=app_id,
+    )
+
+
+def run_ab(
+    engine: str = "recommendation",
+    concurrency: int = 32,
+    requests: int = 960,
+    users: int | None = None,
+    items: int | None = None,
+    events: int | None = None,
+    window_ms: float = 5.0,
+    max_batch_size: int = 64,
+) -> dict:
+    """Train ``engine`` on a synthetic catalog in a throwaway store, then
+    measure the same concurrent load with micro-batching off vs on.
+
+    Both servers run in-process on ephemeral ports; the load clients run
+    in a SUBPROCESS (a co-resident client pool would fight the server
+    threads for the GIL and understate both arms). Each arm gets a
+    warm-up pass first (jit compilation per batch bucket must not land in
+    the measured window). Returns both ``run_load`` reports plus
+    ``qps_speedup``. Responses are identical across arms by construction
+    (same model, same query), which the warm-up also spot-checks.
+    """
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    from predictionio_tpu.data import storage
+    from predictionio_tpu.workflow.core_workflow import run_train
+    from predictionio_tpu.workflow.create_server import create_query_server
+    from predictionio_tpu.workflow.json_extractor import load_engine_variant
+    from predictionio_tpu.workflow.microbatch import BatchConfig
+
+    if engine not in AB_ENGINES:
+        raise ValueError(
+            f"unknown A/B engine {engine!r}; choose from {sorted(AB_ENGINES)}"
+        )
+    spec = AB_ENGINES[engine]
+    users = users if users is not None else spec["defaults"]["users"]
+    items = items if items is not None else spec["defaults"]["items"]
+    events = events if events is not None else spec["defaults"]["events"]
+    prev_basedir = os.environ.get("PIO_FS_BASEDIR")
+    tmp = tempfile.mkdtemp(prefix="pio_serving_ab_")
+    os.environ["PIO_FS_BASEDIR"] = tmp
+    storage.reset()
+    try:
+        app_name = f"ServingAB-{engine}"
+        _ingest_synthetic(app_name, users, items, events)
+        variant_path = os.path.join(tmp, "engine.json")
+        with open(variant_path, "w") as f:
+            json.dump(
+                {
+                    "id": f"serving-ab-{engine}",
+                    "engineFactory": spec["factory"],
+                    "datasource": {"params": {"appName": app_name}},
+                    "algorithms": spec["algorithms"],
+                },
+                f,
+            )
+        variant = load_engine_variant(variant_path)
+        run_train(variant)
+
+        query = {"user": "u1", "num": 10}
+        arms = {
+            "batching_off": BatchConfig(window_ms=0.0),
+            "batching_on": BatchConfig(
+                window_ms=window_ms, max_batch_size=max_batch_size
+            ),
+        }
+        out: dict = {
+            "engine": engine,
+            "concurrency": concurrency,
+            "requests": requests,
+            "users": users,
+            "items": items,
+            "window_ms": window_ms,
+            "max_batch_size": max_batch_size,
+        }
+        def load_in_subprocess(url: str, n_requests: int) -> dict:
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m",
+                    "predictionio_tpu.tools.serving_bench",
+                    "--url", url,
+                    "--concurrency", str(concurrency),
+                    "--requests", str(n_requests),
+                    "--query", json.dumps(query),
+                ],
+                capture_output=True, text=True, timeout=600,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"load subprocess failed: {proc.stderr[-500:]}"
+                )
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        def concurrent_bodies(url: str) -> list[bytes]:
+            """One distinct-user query per client thread, fired together:
+            on the batching arm these COALESCE, so comparing the bodies
+            across arms checks batched result scattering (a per-slot
+            misalignment would swap users' answers), not just the
+            single-query path."""
+            probes = [
+                {"user": f"u{k % users}", "num": 10} for k in range(concurrency)
+            ]
+            bodies: list = [None] * len(probes)
+
+            def worker(k: int) -> None:
+                try:
+                    req = urllib.request.Request(
+                        f"{url}/queries.json",
+                        data=json.dumps(probes[k]).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    )
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        bodies[k] = resp.read()
+                except Exception as exc:  # surfaced below, never swallowed
+                    bodies[k] = exc
+
+            threads = [
+                threading.Thread(target=worker, args=(k,))
+                for k in range(len(probes))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            failed = [b for b in bodies if not isinstance(b, bytes)]
+            if failed:
+                # an unanswered probe must abort loudly, not compare
+                # None==None as "identical"
+                raise RuntimeError(
+                    f"{len(failed)} identity probe(s) failed against {url}: "
+                    f"{failed[0]!r}"
+                )
+            return bodies
+
+        responses: dict[str, list[bytes]] = {}
+        for label, batching in arms.items():
+            thread, service = create_query_server(
+                variant, host="127.0.0.1", port=0, batching=batching
+            )
+            thread.start()
+            url = f"http://127.0.0.1:{thread.port}"
+            try:
+                # warm-up: compile every batch bucket outside the clock
+                load_in_subprocess(url, max(4 * max_batch_size, concurrency))
+                # identity probe under coalescing load (outside the clock)
+                responses[label] = concurrent_bodies(url)
+                out[label] = load_in_subprocess(url, requests)
+            finally:
+                thread.stop()
+                service.close()
+        out["responses_identical"] = (
+            responses["batching_off"] == responses["batching_on"]
+        )
+        out["responses_equivalent"] = all(
+            _responses_equivalent(a, b)
+            for a, b in zip(responses["batching_off"], responses["batching_on"])
+        )
+        off, on = out["batching_off"]["qps"], out["batching_on"]["qps"]
+        out["qps_speedup"] = round(on / off, 2) if off else None
+        return out
+    finally:
+        if prev_basedir is None:
+            os.environ.pop("PIO_FS_BASEDIR", None)
+        else:
+            os.environ["PIO_FS_BASEDIR"] = prev_basedir
+        storage.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--url", default="http://127.0.0.1:8000")
-    ap.add_argument("--clients", type=int, default=8)
-    ap.add_argument("--requests", type=int, default=400)
-    ap.add_argument("--query", default='{"user": "u1", "num": 4}')
-    args = ap.parse_args(argv)
-    print(
-        json.dumps(
-            run_load(args.url, args.query, args.clients, args.requests)
-        )
+    ap.add_argument(
+        "--url", default=None,
+        help="target server; omit to run the self-contained batching A/B",
     )
+    ap.add_argument(
+        "--clients", "--concurrency", dest="clients", type=int, default=None,
+        help="concurrent keep-alive clients (default: 8 load / 32 A/B)",
+    )
+    ap.add_argument("--requests", type=int, default=None,
+                    help="total POSTs (default: 400 load / 960 A/B)")
+    ap.add_argument("--query", default='{"user": "u1", "num": 4}')
+    ap.add_argument(
+        "--engine", default="both",
+        choices=tuple(AB_ENGINES) + ("both",),
+        help="A/B mode: which engine(s) to train and serve",
+    )
+    ap.add_argument("--batch-window-ms", type=float, default=5.0)
+    ap.add_argument("--max-batch-size", type=int, default=64)
+    ap.add_argument("--users", type=int, default=None,
+                    help="A/B catalog size override (default: per engine)")
+    ap.add_argument("--items", type=int, default=None)
+    ap.add_argument("--events", type=int, default=None)
+    args = ap.parse_args(argv)
+    if args.url:
+        print(
+            json.dumps(
+                run_load(
+                    args.url, args.query, args.clients or 8,
+                    args.requests or 400,
+                )
+            )
+        )
+        return 0
+    engines = list(AB_ENGINES) if args.engine == "both" else [args.engine]
+    report = {
+        name: run_ab(
+            name,
+            concurrency=args.clients or 32,
+            requests=args.requests or 960,
+            users=args.users,
+            items=args.items,
+            events=args.events,
+            window_ms=args.batch_window_ms,
+            max_batch_size=args.max_batch_size,
+        )
+        for name in engines
+    }
+    print(json.dumps(report))
     return 0
 
 

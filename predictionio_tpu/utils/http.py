@@ -225,7 +225,15 @@ def make_server(
 
     if ssl_key and not ssl_cert:
         raise ValueError("ssl_key given without ssl_cert; TLS not enabled")
-    server = ThreadingHTTPServer((host, port), _RequestHandler)
+
+    class _Server(ThreadingHTTPServer):
+        # socketserver's default listen backlog is 5: a burst of N>5
+        # simultaneous connects (every load balancer health-check +
+        # client-pool refill looks like this) overflows it and the kernel
+        # drops SYNs, surfacing as 1s/3s/7s retransmit spikes in p99
+        request_queue_size = 128
+
+    server = _Server((host, port), _RequestHandler)
     if ssl_cert:
         import ssl
 

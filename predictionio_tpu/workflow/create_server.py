@@ -19,6 +19,13 @@ Behavioral model: reference ``core/.../workflow/CreateServer.scala``
 Default port 8000. Serving stays off the training mesh: predict calls are
 host-side (factor caches) or single-chip jitted functions prepared at load
 time -- the <5 ms p50 path (SURVEY.md section 7.3).
+
+Concurrent requests are coalesced into padded micro-batches
+(``workflow/microbatch``): request threads park on futures while one
+flusher drives the engines' vectorized ``batch_predict`` paths, so the
+scorer sees batch sizes that grow with load instead of always 1. The
+single-request response surface is preserved byte-for-byte; disable with
+``--batch-window-ms 0``.
 """
 
 from __future__ import annotations
@@ -28,6 +35,7 @@ import json
 import logging
 import threading
 import uuid
+from concurrent.futures import TimeoutError as _FutureTimeout
 from dataclasses import dataclass
 from typing import Any
 
@@ -40,6 +48,11 @@ from predictionio_tpu.utils.http import (
     make_server,
 )
 from predictionio_tpu.workflow.context import RuntimeContext
+from predictionio_tpu.workflow.microbatch import (
+    BatchConfig,
+    BatcherStopped,
+    MicroBatcher,
+)
 from predictionio_tpu.workflow.core_workflow import (
     engine_params_from_instance,
     resolve_engine_instance,
@@ -83,12 +96,14 @@ class QueryService:
         instance_id: str | None = None,
         feedback: FeedbackConfig | None = None,
         plugins: list[EngineServerPlugin] | None = None,
+        batching: BatchConfig | None = None,
     ):
         self.variant = variant
         self.engine = engine or build_engine(variant)
         self.requested_instance_id = instance_id
         self.feedback = feedback
         self.plugins = list(plugins or [])
+        self.batching = BatchConfig() if batching is None else batching
         self._lock = threading.RLock()
         self._served = 0
         self._started = _dt.datetime.now(_dt.timezone.utc)
@@ -110,6 +125,13 @@ class QueryService:
         self.router.add("GET", "/reload", self.handle_reload)
         self.router.add("POST", "/stop", self.handle_stop)
         self._stop_event = threading.Event()
+        # the batcher captures engine state per flush (under self._lock),
+        # so /reload hot-swaps apply to the very next batch
+        self._batcher = (
+            MicroBatcher(self._predict_batch, self.batching, metrics=self.metrics)
+            if self.batching.enabled
+            else None
+        )
 
     # -- model lifecycle ----------------------------------------------------
     def _load_models(self) -> None:
@@ -158,26 +180,123 @@ class QueryService:
                     "algorithms": [type(a).__name__ for a in self.algorithms],
                     "startTime": self._started.isoformat(),
                     "serverStats": {"queryCount": self._served},
+                    "batching": {
+                        "enabled": self._batcher is not None,
+                        "maxBatchSize": self.batching.max_batch_size,
+                        "windowMs": self.batching.window_ms,
+                        "buckets": list(self.batching.buckets),
+                    },
                 },
             )
+
+    def _predict_one(self, query_obj) -> Any:
+        """The unbatched predict -> serve chain for one raw query dict."""
+        with self._lock:
+            algorithms = self.algorithms
+            models = self.models
+            serving = self.serving_instance
+        predictions = []
+        typed_query = algorithms[0].query_from_json(query_obj)
+        for algorithm, model in zip(algorithms, models):
+            query = algorithm.query_from_json(query_obj)
+            predictions.append(algorithm.predict(model, query))
+        # serving receives the typed query, matching Engine.eval's contract
+        return serving.serve(typed_query, predictions)
+
+    def _predict_batch(self, query_objs: list) -> list:
+        """MicroBatcher execute callback: raw query dicts in, one result OR
+        ``Exception`` per slot out (aligned). Per-request isolation: the
+        batched hooks run optimistically for the whole batch; if one
+        raises, the batch degrades to per-query scoring so only the
+        failing queries carry their error (the ``workflow/batch_predict``
+        chunk-fallback pattern, on the serving path)."""
+        with self._lock:
+            algorithms = self.algorithms
+            models = self.models
+            serving = self.serving_instance
+        n = len(query_objs)
+        errors: dict[int, Exception] = {}
+        typed: dict[int, Any] = {}
+        for i, obj in enumerate(query_objs):
+            try:
+                typed[i] = algorithms[0].query_from_json(obj)
+            except Exception as exc:
+                errors[i] = exc
+        per_algo: list[dict[int, Any]] = []
+        for algorithm, model in zip(algorithms, models):
+            pairs = []
+            for i in range(n):
+                if i in errors:
+                    continue
+                try:
+                    pairs.append((i, algorithm.query_from_json(query_objs[i])))
+                except Exception as exc:
+                    errors[i] = exc
+            try:
+                preds = dict(algorithm.batch_predict(model, pairs))
+            except Exception:
+                logger.warning(
+                    "batched predict failed for a %d-query batch; "
+                    "rescoring per query", len(pairs), exc_info=True,
+                )
+                preds = {}
+                for i, q in pairs:
+                    try:
+                        preds[i] = algorithm.predict(model, q)
+                    except Exception as exc:
+                        errors[i] = exc
+            for i, _ in pairs:
+                if i not in preds and i not in errors:
+                    errors[i] = RuntimeError(
+                        f"{type(algorithm).__name__}.batch_predict returned "
+                        f"no result for query {i}"
+                    )
+            per_algo.append(preds)
+        ok = [i for i in range(n) if i not in errors]
+        served: dict[int, Any] = {}
+        if ok:
+            try:
+                out = serving.serve_batch(
+                    [typed[i] for i in ok],
+                    [[preds[i] for preds in per_algo] for i in ok],
+                )
+                if len(out) != len(ok):
+                    raise RuntimeError(
+                        f"serve_batch returned {len(out)} results for "
+                        f"{len(ok)} queries"
+                    )
+                served = dict(zip(ok, out))
+            except Exception:
+                served = {}
+                for i in ok:
+                    try:
+                        served[i] = serving.serve(
+                            typed[i], [preds[i] for preds in per_algo]
+                        )
+                    except Exception as exc:
+                        errors[i] = exc
+        return [errors[i] if i in errors else served[i] for i in range(n)]
 
     def handle_query(self, request: Request) -> Response:
         try:
             query_obj = request.json()
         except json.JSONDecodeError:
             return Response(400, {"message": "malformed JSON query"})
-        with self._lock:
-            algorithms = self.algorithms
-            models = self.models
-            serving = self.serving_instance
         try:
-            predictions = []
-            typed_query = algorithms[0].query_from_json(query_obj)
-            for algorithm, model in zip(algorithms, models):
-                query = algorithm.query_from_json(query_obj)
-                predictions.append(algorithm.predict(model, query))
-            # serving receives the typed query, matching Engine.eval's contract
-            result = serving.serve(typed_query, predictions)
+            if self._batcher is not None:
+                # the window is how long a query may WAIT; the allowance on
+                # top covers execution (first-bucket jit compiles included)
+                wait_s = self.batching.window_ms / 1000.0 + 30.0
+                try:
+                    result = self._batcher.submit(query_obj).result(wait_s)
+                except BatcherStopped:
+                    return Response(503, {"message": "server is stopping"})
+                except _FutureTimeout:
+                    return Response(
+                        503, {"message": "batched predict timed out"}
+                    )
+            else:
+                result = self._predict_one(query_obj)
             for plugin in self.plugins:
                 plugin.output_blocker(query_obj, result)
         except ServerRejection as exc:
@@ -186,7 +305,9 @@ class QueryService:
             return Response(400, {"message": f"bad query: {exc}"})
         for plugin in self.plugins:
             plugin.output_sniffer(query_obj, result)
-        result_json = algorithms[0].result_to_json(result)
+        with self._lock:
+            serializer = self.algorithms[0]
+        result_json = serializer.result_to_json(result)
         if not isinstance(result_json, (dict, list)):
             result_json = {"result": result_json}
         if self.feedback:
@@ -213,6 +334,13 @@ class QueryService:
     def handle_stop(self, request: Request) -> Response:
         self._stop_event.set()
         return Response(200, {"status": "stopping"})
+
+    def close(self) -> None:
+        """Graceful drain: flush every in-flight batched query (their
+        request threads are parked on futures and still get answers), then
+        stop the flusher. Call AFTER the HTTP listener stops accepting."""
+        if self._batcher is not None:
+            self._batcher.close()
 
     # -- feedback loop ------------------------------------------------------
     def _send_feedback(self, query: Any, prediction: Any, pr_id: str) -> None:
@@ -275,3 +403,4 @@ def run_query_server(
     except KeyboardInterrupt:
         pass
     thread.stop()
+    service.close()  # drain in-flight batches after the listener stops

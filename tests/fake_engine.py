@@ -73,6 +73,17 @@ class RetrainAlgorithm(FakeAlgorithm):
     persist_model = False
 
 
+class PoisonableAlgorithm(FakeAlgorithm):
+    """Raises on queries carrying {"boom": true} -- exercises per-request
+    error isolation through the serving micro-batcher (the query parses
+    fine, so it reaches the batch and must fail there, alone)."""
+
+    def predict(self, model: MeanModel, query) -> dict:
+        if isinstance(query, dict) and query.get("boom"):
+            raise ValueError("poison query")
+        return super().predict(model, query)
+
+
 class SelfSavingModel(PersistentModel, MeanModel):
     saved: dict[str, float] = {}
 
@@ -99,6 +110,7 @@ def engine_factory() -> Engine:
             "mean": FakeAlgorithm,
             "retrain": RetrainAlgorithm,
             "persistent": PersistentAlgorithm,
+            "poisonable": PoisonableAlgorithm,
         },
         serving_class=FirstServing,
     )

@@ -1,0 +1,433 @@
+"""Micro-batched query serving: the batcher's flush discipline (size /
+deadline / idle / drain), bucket padding, per-request error isolation, and
+the query server's batched path answering byte-for-byte like the unbatched
+one."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.utils.metrics import MetricsRegistry
+from predictionio_tpu.workflow.microbatch import (
+    BatchConfig,
+    BatcherStopped,
+    MicroBatcher,
+)
+
+
+class Recorder:
+    """Execute callback that records every (padded) batch it receives."""
+
+    def __init__(self, result=lambda q: f"r:{q}", delay=0.0):
+        self.batches = []
+        self.result = result
+        self.delay = delay
+
+    def __call__(self, queries):
+        self.batches.append(list(queries))
+        if self.delay:
+            time.sleep(self.delay)
+        return [self.result(q) for q in queries]
+
+
+class TestMicroBatcher:
+    def test_flush_on_size(self):
+        """A full batch flushes on size alone: with a 10s window and a 10s
+        idle gap, 4 backlogged queries still come back immediately."""
+        gate = threading.Event()
+        batches = []
+
+        def execute(queries):
+            batches.append(list(queries))
+            if queries[0] == "plug":
+                gate.wait(5)  # hold the flusher while the backlog forms
+            return [f"r:{q}" for q in queries]
+
+        reg = MetricsRegistry()
+        b = MicroBatcher(
+            execute,
+            # idle_ms=1 lets the plug flush alone; the 4 backlogged queries
+            # then sweep into one size-4 batch despite the 10s window
+            BatchConfig(
+                max_batch_size=4, window_ms=10_000, idle_ms=1,
+                buckets=(1, 4),
+            ),
+            metrics=reg,
+        )
+        try:
+            plug = b.submit("plug")
+            time.sleep(0.05)
+            futures = [b.submit(k) for k in range(4)]
+            gate.set()
+            plug.result(timeout=5)
+            t0 = time.perf_counter()
+            results = [f.result(timeout=5) for f in futures]
+            assert time.perf_counter() - t0 < 5  # not the 10s window
+            assert results == ["r:0", "r:1", "r:2", "r:3"]
+            assert batches[1] == [0, 1, 2, 3]
+            series = reg._counters["pio_serving_batch_flush_total"]
+            reasons = {dict(k)["reason"] for k in series}
+            assert "size" in reasons
+        finally:
+            gate.set()
+            b.close()
+
+    def test_flush_on_deadline(self):
+        """A lone query flushes once the window closes, not sooner than
+        the idle gap and never later than window + slack."""
+        rec = Recorder()
+        reg = MetricsRegistry()
+        b = MicroBatcher(
+            rec,
+            BatchConfig(max_batch_size=64, window_ms=50, idle_ms=50),
+            metrics=reg,
+        )
+        try:
+            t0 = time.perf_counter()
+            assert b.submit("solo").result(timeout=5) == "r:solo"
+            elapsed = time.perf_counter() - t0
+            assert elapsed >= 0.045, elapsed  # waited out the window
+            series = reg._counters["pio_serving_batch_flush_total"]
+            reasons = {dict(k)["reason"] for k in series}
+            assert reasons & {"deadline", "idle"}
+        finally:
+            b.close()
+
+    def test_backlog_coalesces_into_one_batch(self):
+        """Queries that queued while the flusher was busy must come out as
+        ONE batch, not trickle out one by one (the window bounds waiting
+        for future arrivals, not collecting the backlog)."""
+        rec = Recorder(delay=0.05)  # first flush holds the flusher busy
+        b = MicroBatcher(
+            rec, BatchConfig(max_batch_size=64, window_ms=1, buckets=(1, 64))
+        )
+        try:
+            first = b.submit("head")
+            time.sleep(0.01)  # flusher is now sleeping inside execute
+            rest = [b.submit(k) for k in range(8)]
+            first.result(timeout=5)
+            for f in rest:
+                f.result(timeout=5)
+            # batch 1 = the head; batch 2 = the entire backlog at once
+            assert len(rec.batches[1]) >= 8
+        finally:
+            b.close()
+
+    def test_bucket_padding(self):
+        """A 3-query flush pads to the next bucket (4) by repeating the
+        last query; padded results are dropped, real results align."""
+        gate = threading.Event()
+        batches = []
+
+        def execute(queries):
+            batches.append(list(queries))
+            if queries[0] == "plug":
+                gate.wait(5)
+            return [f"r:{q}" for q in queries]
+
+        b = MicroBatcher(
+            execute,
+            BatchConfig(max_batch_size=16, window_ms=30, buckets=(1, 4, 16)),
+        )
+        try:
+            plug = b.submit("plug")
+            time.sleep(0.05)
+            futures = [b.submit(k) for k in range(3)]
+            gate.set()
+            plug.result(timeout=5)
+            results = [f.result(timeout=5) for f in futures]
+            assert results == ["r:0", "r:1", "r:2"]
+            batch = batches[1]
+            assert len(batch) == 4          # padded to the bucket
+            assert batch == [0, 1, 2, 2]    # pad repeats the last query
+        finally:
+            gate.set()
+            b.close()
+
+    def test_error_isolation(self):
+        """An Exception entry fails only its own future."""
+        def execute(queries):
+            return [
+                ValueError(f"bad {q}") if q == "poison" else f"ok:{q}"
+                for q in queries
+            ]
+
+        b = MicroBatcher(
+            execute, BatchConfig(max_batch_size=8, window_ms=30, buckets=(8,))
+        )
+        try:
+            good1 = b.submit("a")
+            bad = b.submit("poison")
+            good2 = b.submit("b")
+            assert good1.result(timeout=5) == "ok:a"
+            assert good2.result(timeout=5) == "ok:b"
+            with pytest.raises(ValueError, match="bad poison"):
+                bad.result(timeout=5)
+        finally:
+            b.close()
+
+    def test_wholesale_failure_fails_the_batch(self):
+        def execute(queries):
+            raise RuntimeError("model exploded")
+
+        b = MicroBatcher(execute, BatchConfig(max_batch_size=8, window_ms=10))
+        try:
+            with pytest.raises(RuntimeError, match="model exploded"):
+                b.submit("q").result(timeout=5)
+        finally:
+            b.close()
+
+    def test_graceful_drain_on_close(self):
+        """close() flushes in-flight queries (their futures complete) and
+        further submits are refused."""
+        rec = Recorder()
+        reg = MetricsRegistry()
+        b = MicroBatcher(
+            rec,
+            # a long window: without the drain these would sit for 10s
+            BatchConfig(max_batch_size=64, window_ms=10_000, idle_ms=10_000),
+            metrics=reg,
+        )
+        futures = [b.submit(k) for k in range(3)]
+        b.close()
+        assert [f.result(timeout=5) for f in futures] == ["r:0", "r:1", "r:2"]
+        with pytest.raises(BatcherStopped):
+            b.submit("late")
+        series = reg._counters["pio_serving_batch_flush_total"]
+        reasons = {dict(k)["reason"] for k in series}
+        assert "drain" in reasons
+        b.close()  # idempotent
+
+    def test_disabled_configs(self):
+        assert not BatchConfig(window_ms=0).enabled
+        assert not BatchConfig(max_batch_size=1).enabled
+        assert BatchConfig().enabled
+
+
+def _train_fake_engine(storage_env, tmp_path, app="BatchServeApp",
+                       algorithm="mean"):
+    import os
+    import sys
+
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.workflow.core_workflow import run_train
+    from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    app_id = storage_env.get_meta_data_apps().insert(App(name=app))
+    le = storage_env.get_l_events()
+    le.init_channel(app_id)
+    le.batch_insert(
+        [
+            Event(event="rate", entity_type="user", entity_id=f"u{k % 4}",
+                  target_entity_type="item", target_entity_id=f"i{k}",
+                  properties=DataMap({"rating": float(1 + k % 5)}))
+            for k in range(20)
+        ],
+        app_id=app_id,
+    )
+    variant_path = tmp_path / "engine.json"
+    variant_path.write_text(json.dumps({
+        "id": "default",
+        "engineFactory": "fake_engine.engine_factory",
+        "datasource": {"params": {"appName": app}},
+        "algorithms": [{"name": algorithm, "params": {}}],
+    }))
+    variant = load_engine_variant(str(variant_path))
+    run_train(variant)
+    return variant
+
+
+def _post(url, obj, timeout=15):
+    req = urllib.request.Request(
+        f"{url}/queries.json",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read()
+
+
+class TestBatchedQueryServer:
+    def test_batched_responses_byte_identical(self, storage_env, tmp_path):
+        """The same queries through a batching and a non-batching server
+        produce byte-for-byte identical bodies, and concurrent queries
+        coalesce (the batching server's flush metrics show multi-query
+        batches)."""
+        from predictionio_tpu.workflow.create_server import create_query_server
+
+        variant = _train_fake_engine(storage_env, tmp_path)
+        servers = {}
+        for label, batching in (
+            ("off", BatchConfig(window_ms=0)),
+            ("on", BatchConfig(window_ms=20, max_batch_size=16)),
+        ):
+            servers[label] = create_query_server(
+                variant, host="127.0.0.1", port=0, batching=batching
+            )
+            servers[label][0].start()
+        try:
+            bodies = {"off": [], "on": []}
+            for label, (thread, _) in servers.items():
+                url = f"http://127.0.0.1:{thread.port}"
+                # concurrent wave: exercises coalescing on the batching arm
+                results = [None] * 8
+
+                def worker(k, url=url, out=results):
+                    out[k] = _post(url, {"user": f"u{k % 4}", "num": 3})
+
+                threads = [
+                    threading.Thread(target=worker, args=(k,))
+                    for k in range(8)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                assert all(status == 200 for status, _ in results), results
+                bodies[label] = [body for _, body in results]
+            assert bodies["on"] == bodies["off"]
+            # the batching arm really batched: flush metrics exist and the
+            # info page advertises the config
+            thread, service = servers["on"]
+            url = f"http://127.0.0.1:{thread.port}"
+            with urllib.request.urlopen(f"{url}/", timeout=10) as resp:
+                info = json.load(resp)
+            assert info["batching"]["enabled"] is True
+            metrics = urllib.request.urlopen(
+                f"{url}/metrics", timeout=10
+            ).read().decode()
+            assert "pio_serving_batch_size_count" in metrics
+            assert "pio_serving_batch_flush_total" in metrics
+        finally:
+            for thread, service in servers.values():
+                thread.stop()
+                service.close()
+
+    def test_per_request_isolation_through_http(self, storage_env, tmp_path):
+        """A query that raises INSIDE a coalesced batch (it parses fine,
+        so it reaches the batcher) 400s alone; its batchmates still answer
+        200 with correct bodies."""
+        from predictionio_tpu.workflow.create_server import create_query_server
+
+        variant = _train_fake_engine(
+            storage_env, tmp_path, app="IsolApp", algorithm="poisonable"
+        )
+        thread, service = create_query_server(
+            variant, host="127.0.0.1", port=0,
+            # a wide window so the wave coalesces into one batch
+            batching=BatchConfig(window_ms=200, idle_ms=100,
+                                 max_batch_size=16),
+        )
+        thread.start()
+        url = f"http://127.0.0.1:{thread.port}"
+        try:
+            results = [None] * 6
+
+            def worker(k):
+                if k == 2:
+                    results[k] = _post(url, {"user": "u1", "boom": True})
+                else:
+                    results[k] = _post(url, {"user": "u1", "num": 2})
+
+            threads = [
+                threading.Thread(target=worker, args=(k,)) for k in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            statuses = [status for status, _ in results]
+            assert statuses[2] == 400
+            assert b"poison query" in results[2][1]
+            assert all(s == 200 for k, s in enumerate(statuses) if k != 2)
+            good = {body for k, (_, body) in enumerate(results) if k != 2}
+            assert len(good) == 1  # batchmates all got the same right answer
+            # and at least one multi-query batch actually formed
+            metrics = urllib.request.urlopen(
+                f"{url}/metrics", timeout=10
+            ).read().decode()
+            count = sum_v = None
+            for line in metrics.splitlines():
+                if line.startswith("pio_serving_batch_size_count"):
+                    count = float(line.rsplit(" ", 1)[1])
+                if line.startswith("pio_serving_batch_size_sum"):
+                    sum_v = float(line.rsplit(" ", 1)[1])
+            assert count and sum_v and sum_v > count  # avg batch size > 1
+        finally:
+            thread.stop()
+            service.close()
+
+    def test_batch_predict_error_isolation_direct(self, storage_env, tmp_path):
+        """QueryService._predict_batch: a query that makes the algorithm
+        raise yields an Exception slot; batchmates score normally (the
+        optimistic-batch -> per-query fallback)."""
+        from predictionio_tpu.workflow.create_server import QueryService
+
+        variant = _train_fake_engine(storage_env, tmp_path, app="DirectApp")
+        service = QueryService(variant, batching=BatchConfig(window_ms=0))
+        algorithm = service.algorithms[0]
+
+        original = type(algorithm).predict
+
+        def exploding(self, model, query):
+            if isinstance(query, dict) and query.get("boom"):
+                raise ValueError("boom query")
+            return original(self, model, query)
+
+        type(algorithm).predict = exploding
+        try:
+            results = service._predict_batch(
+                [{"user": "u1"}, {"user": "u2", "boom": True}, {"user": "u3"}]
+            )
+            assert results[0] == {"rating": pytest.approx(3.0, abs=2.0)}
+            assert isinstance(results[1], ValueError)
+            assert results[2] == results[0]
+        finally:
+            type(algorithm).predict = original
+            service.close()
+
+    def test_drain_on_stop_answers_inflight(self, storage_env, tmp_path):
+        """Queries parked in a long batching window still get answers when
+        the server stops: close() drains instead of stranding futures."""
+        from predictionio_tpu.workflow.create_server import create_query_server
+
+        variant = _train_fake_engine(storage_env, tmp_path, app="DrainApp")
+        thread, service = create_query_server(
+            variant, host="127.0.0.1", port=0,
+            batching=BatchConfig(
+                window_ms=30_000, idle_ms=30_000, max_batch_size=64
+            ),
+        )
+        thread.start()
+        url = f"http://127.0.0.1:{thread.port}"
+        try:
+            results = [None] * 2
+            threads = [
+                threading.Thread(
+                    target=lambda k=k: results.__setitem__(
+                        k, _post(url, {"user": "u1"}, timeout=20)
+                    )
+                )
+                for k in range(2)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # both queries are parked in the open window
+            service.close()  # graceful drain flushes them
+            for t in threads:
+                t.join(timeout=20)
+            assert all(r is not None and r[0] == 200 for r in results), results
+        finally:
+            thread.stop()
+            service.close()
