@@ -360,11 +360,32 @@ def secondary_main(result_path: str) -> None:
             "config": "#6 serving_qps (16 clients, 30k items, rank 64)",
         }
 
+    def ingest_eps():
+        """#7: Event Server ingestion events/sec, per-request durable sync
+        commits vs WAL group commit (sqlite, 32 concurrent writers), plus a
+        SIGKILL-and-replay exactly-once check. Storage-layer only -- no JAX,
+        runs identically on the TPU and CPU secondary children. Full-size
+        A/B: `python -m predictionio_tpu.tools.ingest_bench`."""
+        from predictionio_tpu.tools.ingest_bench import run_ab
+
+        rep = run_ab(clients=32, events_per_client=25, crash_events=150)
+        return {
+            "eps_sync_durable": rep["sync"]["eps"],
+            "eps_sync_nondurable": rep["sync_nondurable"]["eps"],
+            "eps_group_commit": rep["wal"]["eps"],
+            "eps_speedup": rep["speedup"],
+            "eps_speedup_vs_nondurable": rep["speedup_vs_nondurable_sync"],
+            "crash_exactly_once": rep["crash_cycle"]["exactly_once"],
+            "crash_replayed": rep["crash_cycle"]["replayed"],
+            "config": "#7 ingest_eps (32 writers, sqlite, fsync=always)",
+        }
+
     phase("naive_bayes_fit", nb_fit)
     phase("logreg_lbfgs_fit", logreg_fit)
     phase("cooccurrence_llr_indicators", cooc_indicators)
     phase("ncf_batchpredict", ncf_batchpredict)
     phase("serving_qps", serving_qps)
+    phase("ingest_eps", ingest_eps)
 
 
 def child_main(mode: str, result_path: str) -> None:

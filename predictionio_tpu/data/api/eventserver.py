@@ -22,8 +22,11 @@ Default port 7070.
 from __future__ import annotations
 
 import json
+import logging
+import math
 import threading
 import time
+from concurrent.futures import TimeoutError as _FutureTimeout
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -33,7 +36,14 @@ from predictionio_tpu.data.event import (
     EventValidationError,
     parse_event_time,
 )
+from predictionio_tpu.data.ingest import (
+    IngestConfig,
+    IngestOverload,
+    IngestPipeline,
+    replay_wal_into_storage,
+)
 from predictionio_tpu.data.storage.base import AccessKey
+from predictionio_tpu.data.wal import WriteAheadLog
 from predictionio_tpu.data import webhooks as webhook_registry
 from predictionio_tpu.utils.http import (
     Request,
@@ -44,6 +54,10 @@ from predictionio_tpu.utils.http import (
 )
 
 DEFAULT_PORT = 7070
+
+#: how long a request thread waits for its group-commit ack before giving up
+#: with a 503 (a stalled storage backend must not hold sockets forever)
+ACK_TIMEOUT_S = 30.0
 
 
 class EventServerPlugin:
@@ -99,11 +113,22 @@ class _Stats:
 class EventService:
     """Route handlers bound to the storage registry; server-framework free."""
 
-    def __init__(self, stats: bool = False, plugins: list[EventServerPlugin] | None = None):
+    def __init__(
+        self,
+        stats: bool = False,
+        plugins: list[EventServerPlugin] | None = None,
+        ingest_config: IngestConfig | None = None,
+    ):
         self.stats_enabled = stats
         self.stats = _Stats()
         self.plugins = list(plugins or [])
-        self.router, self.metrics = instrumented_router()
+        self.ingest: IngestPipeline | None = None
+        self._wal: WriteAheadLog | None = None
+        self.router, self.metrics = instrumented_router(
+            before_scrape=self._before_scrape
+        )
+        if ingest_config is not None and ingest_config.mode == "wal":
+            self._start_ingest(ingest_config)
         r = self.router
         r.add("GET", "/", self.handle_root)
         r.add("POST", "/events.json", self.handle_create_event)
@@ -114,6 +139,50 @@ class EventService:
         r.add("GET", "/stats.json", self.handle_stats)
         r.add("POST", "/webhooks/<connector>.json", self.handle_webhook_post)
         r.add("GET", "/webhooks/<connector>.json", self.handle_webhook_get)
+
+    # -- ingest pipeline lifecycle ------------------------------------------
+    def _start_ingest(self, config: IngestConfig) -> None:
+        """WAL + group-commit mode: replay the un-flushed tail left by a
+        previous crash, then start the background writer."""
+        self._wal = WriteAheadLog(
+            config.resolved_wal_dir(),
+            segment_bytes=config.segment_bytes,
+            fsync_policy=config.fsync_policy,
+        )
+        replayed = replay_wal_into_storage(self._wal)
+        if replayed:
+            logging.getLogger("pio.ingest").warning(
+                "replayed %d WAL record(s) into the event store", replayed
+            )
+        self.ingest = IngestPipeline(
+            self._wal,
+            queue_size=config.queue_size,
+            group_commit_ms=config.group_commit_ms,
+            max_batch=config.max_batch,
+            metrics=self.metrics,
+        ).start()
+
+    def shutdown_ingest(self) -> None:
+        """Drain the queue (every accepted event reaches the WAL + store)
+        and close the WAL. Safe to call in sync mode or twice.
+
+        ``self.ingest`` deliberately stays set: handler threads can still be
+        mid-request after the listener closes (daemon handler threads), and a
+        stopped pipeline answers their submits with IngestOverload -> 429
+        rather than an attribute race."""
+        if self.ingest is not None:
+            self.ingest.stop(drain=True)
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+
+    def _before_scrape(self, registry) -> None:
+        if self.ingest is not None:
+            registry.set_gauge(
+                "pio_ingest_queue_depth",
+                float(self.ingest.depth()),
+                help="Events parked in the ingest queue awaiting group commit",
+            )
 
     # -- auth ---------------------------------------------------------------
     def _access_key(self, request: Request) -> str | None:
@@ -163,9 +232,11 @@ class EventService:
     def handle_root(self, request: Request) -> Response:
         return Response(200, {"status": "alive"})
 
-    def _insert_one(
+    def _prepare(
         self, obj: Any, record: AccessKey, channel_id: int | None
-    ) -> tuple[int, dict[str, Any]]:
+    ) -> Event | tuple[int, dict[str, Any]]:
+        """Validate + authorize + run input blockers on the request thread;
+        returns the Event, or the (status, body) rejection."""
         try:
             if isinstance(obj, dict):
                 # creationTime is server-assigned on the ingest path; a client
@@ -175,28 +246,105 @@ class EventService:
             self._check_event_allowed(record, event.event)
             for plugin in self.plugins:
                 plugin.input_blocker(event, record.app_id, channel_id)
-            event_id = storage_registry.get_l_events().insert(
-                event, record.app_id, channel_id
-            )
-            for plugin in self.plugins:
-                plugin.input_sniffer(event, record.app_id, channel_id)
-            if self.stats_enabled:
-                self.stats.record(record.app_id, event.event, 201)
-            self.metrics.inc(
-                "pio_events_ingested_total",
-                {"app_id": str(record.app_id)},
-                help="Events accepted into the event store",
-            )
-            return 201, {"eventId": event_id}
+            return event
         except EventValidationError as exc:
             if self.stats_enabled:
                 name = obj.get("event", "<invalid>") if isinstance(obj, dict) else "<invalid>"
                 self.stats.record(record.app_id, str(name), 400)
             return 400, {"message": str(exc)}
         except _AuthError as exc:
+            # whitelist denial: surface in /stats.json like any other outcome
+            if self.stats_enabled and isinstance(obj, dict):
+                self.stats.record(record.app_id, str(obj.get("event")), exc.status)
             return exc.status, {"message": str(exc)}
         except PluginRejection as exc:
+            if self.stats_enabled and isinstance(obj, dict):
+                self.stats.record(record.app_id, str(obj.get("event")), exc.status)
             return exc.status, {"message": str(exc)}
+
+    def _ack(
+        self, event: Event, record: AccessKey, channel_id: int | None, event_id: str
+    ) -> tuple[int, dict[str, Any]]:
+        for plugin in self.plugins:
+            plugin.input_sniffer(event, record.app_id, channel_id)
+        if self.stats_enabled:
+            self.stats.record(record.app_id, event.event, 201)
+        self.metrics.inc(
+            "pio_events_ingested_total",
+            {"app_id": str(record.app_id)},
+            help="Events accepted into the event store",
+        )
+        return 201, {"eventId": event_id}
+
+    def _insert_prepared(
+        self, events: list[Event], record: AccessKey, channel_id: int | None
+    ) -> list[tuple[int, dict[str, Any]]]:
+        """Commit already-validated events. Sync mode: one storage insert
+        per event on the request thread (the pre-pipeline behavior). WAL
+        mode: submit ALL of them before waiting, so a batch request rides a
+        single group commit; a full queue yields per-item 429s."""
+        if self.ingest is None:
+            return [
+                self._ack(
+                    ev,
+                    record,
+                    channel_id,
+                    storage_registry.get_l_events().insert(
+                        ev, record.app_id, channel_id
+                    ),
+                )
+                for ev in events
+            ]
+        submitted: list[Any] = []
+        for ev in events:
+            try:
+                submitted.append(self.ingest.submit(ev, record.app_id, channel_id))
+            except IngestOverload as exc:
+                submitted.append(exc)
+        results = []
+        # one shared deadline for the whole request: a stalled pipeline must
+        # bound the socket hold at ACK_TIMEOUT_S total, not per item
+        deadline = time.monotonic() + ACK_TIMEOUT_S
+        for ev, fut in zip(events, submitted):
+            if isinstance(fut, IngestOverload):
+                results.append(
+                    (429, {"message": "ingestion queue full, retry later"})
+                )
+                continue
+            try:
+                event_id = fut.result(
+                    timeout=max(0.0, deadline - time.monotonic())
+                )
+            except _FutureTimeout:
+                results.append(
+                    (503, {"message": "ingestion pipeline stalled, retry later"})
+                )
+                continue
+            except IngestOverload:
+                results.append(
+                    (429, {"message": "ingestion queue full, retry later"})
+                )
+                continue
+            except Exception as exc:
+                results.append(
+                    (500, {"message": f"ingestion failed: {exc}"})
+                )
+                continue
+            results.append(self._ack(ev, record, channel_id, event_id))
+        return results
+
+    def _insert_one(
+        self, obj: Any, record: AccessKey, channel_id: int | None
+    ) -> tuple[int, dict[str, Any]]:
+        prepared = self._prepare(obj, record, channel_id)
+        if not isinstance(prepared, Event):
+            return prepared
+        return self._insert_prepared([prepared], record, channel_id)[0]
+
+    def _retry_after_headers(self, status: int) -> dict[str, str]:
+        if status != 429 or self.ingest is None:
+            return {}
+        return {"Retry-After": str(max(1, math.ceil(self.ingest.retry_after_s)))}
 
     def handle_create_event(self, request: Request) -> Response:
         try:
@@ -208,7 +356,7 @@ class EventService:
         except json.JSONDecodeError:
             return Response(400, {"message": "malformed JSON body"})
         status, body = self._insert_one(obj, record, channel_id)
-        return Response(status, body)
+        return Response(status, body, headers=self._retry_after_headers(status))
 
     def handle_batch(self, request: Request) -> Response:
         try:
@@ -225,9 +373,17 @@ class EventService:
             return Response(
                 400, {"message": "batch size must be <= 50 events per request"}
             )
+        # two-phase so the whole request rides one group commit in WAL mode:
+        # prepare (reject invalid items individually), submit the valid ones
+        # together, then stitch per-item statuses back in request order
+        prepared: list[Event | tuple[int, dict[str, Any]]] = [
+            self._prepare(obj, record, channel_id) for obj in objs
+        ]
+        valid = [p for p in prepared if isinstance(p, Event)]
+        committed = iter(self._insert_prepared(valid, record, channel_id))
         results = []
-        for obj in objs:
-            status, body = self._insert_one(obj, record, channel_id)
+        for p in prepared:
+            status, body = next(committed) if isinstance(p, Event) else p
             results.append({"status": status, **body})
         return Response(200, results)
 
@@ -272,6 +428,10 @@ class EventService:
                 limit = int(q["limit"])
             except ValueError:
                 return Response(400, {"message": "limit must be an integer"})
+            if limit < -1:
+                return Response(
+                    400, {"message": "limit must be -1 (unlimited) or >= 0"}
+                )
         event_names = q["event"].split(",") if "event" in q else None
         kwargs: dict[str, Any] = {}
         if "targetEntityType" in q:
@@ -286,7 +446,9 @@ class EventService:
             entity_type=q.get("entityType"),
             entity_id=q.get("entityId"),
             event_names=event_names,
-            limit=limit if limit is not None else 20,
+            # upstream parity: limit=-1 means unlimited (None to the DAO);
+            # absent means the default page of 20
+            limit=20 if limit is None else (None if limit == -1 else limit),
             reversed=q.get("reversed", "false").lower() == "true",
             **kwargs,
         )
@@ -326,7 +488,7 @@ class EventService:
         except json.JSONDecodeError:
             return Response(400, {"message": "malformed JSON body"})
         status, body = self._insert_one(event.to_json_obj(), record, channel_id)
-        return Response(status, body)
+        return Response(status, body, headers=self._retry_after_headers(status))
 
     def handle_webhook_get(self, request: Request) -> Response:
         name = request.path_params["connector"]
@@ -347,10 +509,13 @@ def create_event_server(
     port: int = DEFAULT_PORT,
     stats: bool = False,
     plugins: list[EventServerPlugin] | None = None,
+    ingest_config: IngestConfig | None = None,
 ) -> ServiceThread:
-    service = EventService(stats=stats, plugins=plugins)
+    service = EventService(stats=stats, plugins=plugins, ingest_config=ingest_config)
     server = make_server(service.router, host, port, "pio-eventserver")
-    return ServiceThread(server)
+    # drain the group-commit queue on stop: every acknowledged event reaches
+    # the WAL and the store before the thread reports stopped
+    return ServiceThread(server, on_stop=service.shutdown_ingest)
 
 
 def run_event_server(
@@ -359,19 +524,25 @@ def run_event_server(
     stats: bool = False,
     ssl_cert: str | None = None,
     ssl_key: str | None = None,
+    plugins: list[EventServerPlugin] | None = None,
+    ingest_config: IngestConfig | None = None,
 ) -> None:
     """Blocking entry point used by ``pio eventserver``."""
-    service = EventService(stats=stats)
+    service = EventService(stats=stats, plugins=plugins, ingest_config=ingest_config)
     server = make_server(
         service.router, host, port, "pio-eventserver",
         ssl_cert=ssl_cert, ssl_key=ssl_key,
     )
     scheme = "https" if ssl_cert else "http"
+    mode = "wal" if service.ingest is not None else "sync"
     print(
         f"Event Server listening on {scheme}://{host}:{port}"
-        f" (stats={'on' if stats else 'off'})"
+        f" (stats={'on' if stats else 'off'}, ingest={mode},"
+        f" plugins={len(service.plugins)})"
     )
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.server_close()
+    finally:
+        service.shutdown_ingest()

@@ -1,0 +1,368 @@
+"""Durable group-commit ingestion pipeline for the Event Server.
+
+Per-record storage commits are the canonical ingestion bottleneck (each
+``POST /events.json`` paying one transaction); the pipeline replaces them
+with the classic WAL + group-commit design:
+
+1. request threads park on a bounded queue (full queue -> 429 backpressure
+   via :class:`IngestOverload`, instead of unbounded thread pile-up);
+2. a single background writer drains the queue in batches bounded by
+   ``max_batch`` / ``group_commit_ms``, frames the batch into the WAL
+   (``data/wal.py``) and makes it durable with ONE fsync;
+3. requests are acknowledged at that point -- durability comes from the
+   WAL, not the store;
+4. the batch is flushed into the event store through
+   ``LEvents.insert_batch`` (single transaction / ``executemany`` on the
+   SQL backends), after which the WAL checkpoint advances.
+
+A crash anywhere between ack and checkpoint is recovered by
+:func:`replay_wal_into_storage` at startup: event ids are assigned BEFORE
+the WAL append, and replay inserts with ``on_duplicate="ignore"``, so the
+cycle is exactly-once -- nothing acked is lost, nothing is double-applied.
+(Process crashes are covered unconditionally; surviving host power loss
+additionally requires the event store's own commits to be durable --
+postgres/mysql defaults, or sqlite with ``SYNCHRONOUS=FULL`` -- because
+the checkpoint advances once the store COMMITS, not once it fsyncs.)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.wal import WriteAheadLog
+
+logger = logging.getLogger("pio.ingest")
+
+#: batch-size histogram buckets (events per group commit)
+BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0)
+
+
+@dataclass
+class IngestConfig:
+    """CLI/server-facing knobs (``pio eventserver --ingest-*``)."""
+
+    mode: str = "sync"            # sync | wal
+    queue_size: int = 2048
+    group_commit_ms: float = 5.0
+    max_batch: int = 256
+    fsync_policy: str = "always"  # always | interval | never
+    wal_dir: str | None = None    # default: $PIO_FS_BASEDIR/wal
+    segment_bytes: int = 64 << 20
+
+    def resolved_wal_dir(self) -> str:
+        if self.wal_dir:
+            return self.wal_dir
+        import os
+
+        from predictionio_tpu.data.storage import base_dir
+
+        return os.path.join(base_dir(), "wal")
+
+
+class IngestOverload(Exception):
+    """Bounded ingest queue is full; callers map this to HTTP 429."""
+
+    def __init__(self, retry_after_s: float = 1.0):
+        super().__init__("ingestion queue full")
+        self.retry_after_s = retry_after_s
+
+
+@dataclass
+class _Pending:
+    event: Event
+    app_id: int
+    channel_id: int | None
+    future: Future = field(default_factory=Future)
+
+
+def _wal_payload(event: Event, app_id: int, channel_id: int | None) -> bytes:
+    return json.dumps(
+        {"e": event.to_json_obj(), "a": app_id, "c": channel_id},
+        separators=(",", ":"),
+    ).encode("utf-8")
+
+
+def _wal_parse(payload: bytes) -> tuple[Event, int, int | None]:
+    obj = json.loads(payload.decode("utf-8"))
+    return Event.from_json_obj(obj["e"]), obj["a"], obj["c"]
+
+
+class IngestPipeline:
+    """Single-writer group-commit pipeline in front of ``LEvents``.
+
+    ``l_events`` is a zero-arg callable returning the DAO (resolved per
+    flush so tests/env changes that reset the storage registry keep
+    working). With ``wal=None`` the pipeline still group-commits but acks
+    only after the storage flush (no durability layer to ack from).
+    """
+
+    def __init__(
+        self,
+        wal: WriteAheadLog | None,
+        l_events=None,
+        queue_size: int = 2048,
+        group_commit_ms: float = 5.0,
+        max_batch: int = 256,
+        metrics=None,
+    ):
+        if l_events is None:
+            from predictionio_tpu.data import storage as storage_registry
+
+            l_events = storage_registry.get_l_events
+        self.wal = wal
+        self._l_events = l_events
+        self._queue: queue.Queue[_Pending] = queue.Queue(maxsize=queue_size)
+        self.group_commit_s = group_commit_ms / 1000.0
+        self.max_batch = max_batch
+        self.metrics = metrics
+        self._stopping = threading.Event()
+        # serializes the stopping-check-then-enqueue in submit() against
+        # stop()'s flag set: once the flag is visible, no further enqueue can
+        # land, so the writer's final queue-empty check is race-free and no
+        # future is ever stranded unresolved
+        self._submit_gate = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._writer_loop, name="pio-ingest-writer", daemon=True
+        )
+        self.retry_after_s = max(1.0, group_commit_ms / 1000.0)
+        self.storage_errors = 0
+        # WAL-acked batches whose storage flush failed, oldest first as
+        # (items, last_seqno). The writer re-flushes them in order and the
+        # checkpoint NEVER advances past them -- otherwise a later healthy
+        # batch's checkpoint would strand (then GC) acked records. Bounded:
+        # past _retry_cap events, submit() applies backpressure.
+        self._retry_batches: list[tuple[list, int]] = []
+        self._retry_events = 0
+        self._retry_cap = max(queue_size, 1024)
+        self._last_retry = 0.0
+
+    # -- request side ---------------------------------------------------------
+    def start(self) -> "IngestPipeline":
+        self._thread.start()
+        return self
+
+    def submit(self, event: Event, app_id: int, channel_id: int | None) -> Future:
+        """Enqueue one event; the returned future resolves to its eventId
+        once the record is durable. Raises :class:`IngestOverload` when the
+        queue is full (the backpressure contract)."""
+        if self._retry_events > self._retry_cap:
+            # storage has been down long enough to back up the retry
+            # backlog: stop acking new work instead of buffering unboundedly
+            raise IngestOverload(self.retry_after_s)
+        # the id is assigned BEFORE the WAL append so replay after a crash
+        # re-applies the same identity (exactly-once via duplicate skip)
+        pending = _Pending(
+            event if event.event_id else event.with_id(), app_id, channel_id
+        )
+        with self._submit_gate:
+            if self._stopping.is_set():
+                raise IngestOverload(self.retry_after_s)
+            try:
+                self._queue.put_nowait(pending)
+            except queue.Full:
+                raise IngestOverload(self.retry_after_s) from None
+        return pending.future
+
+    def depth(self) -> int:
+        return self._queue.qsize()
+
+    # -- writer side ----------------------------------------------------------
+    def _collect_batch(self) -> list[_Pending]:
+        """Block for the first item, then gather until the group-commit
+        deadline or the batch cap. During shutdown, drain without waiting."""
+        try:
+            first = self._queue.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        deadline = time.monotonic() + self.group_commit_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if self._stopping.is_set():
+                remaining = 0.0
+            try:
+                if remaining > 0:
+                    batch.append(self._queue.get(timeout=remaining))
+                else:
+                    batch.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return batch
+
+    def _writer_loop(self) -> None:
+        while True:
+            batch = self._collect_batch()
+            if not batch:
+                self._flush_retries()
+                if self._stopping.is_set() and self._queue.empty():
+                    self._flush_retries(force=True)  # last chance pre-exit;
+                    # anything still parked survives in the WAL for replay
+                    return
+                continue
+            try:
+                self._commit(batch)
+            except Exception as exc:  # a poisoned batch must not kill the writer
+                for p in batch:
+                    if not p.future.done():
+                        p.future.set_exception(exc)
+
+    def _flush_retries(self, force: bool = False) -> None:
+        """Re-flush parked batches IN ORDER, advancing the checkpoint as each
+        lands; stop at the first failure (ordering preserves the contiguous-
+        prefix invariant the checkpoint depends on)."""
+        if not self._retry_batches:
+            return
+        if not force and time.monotonic() - self._last_retry < 0.25:
+            return
+        self._last_retry = time.monotonic()
+        while self._retry_batches:
+            items, last_seqno = self._retry_batches[0]
+            try:
+                self._l_events().insert_batch(items, on_duplicate="ignore")
+            except Exception:
+                return
+            self._retry_batches.pop(0)
+            self._retry_events -= len(items)
+            if self.wal is not None:
+                self.wal.checkpoint(last_seqno)
+
+    def _commit(self, batch: list[_Pending]) -> None:
+        t0 = time.perf_counter()
+        last_seqno = None
+        if self.wal is not None:
+            for p in batch:
+                last_seqno = self.wal.append(
+                    _wal_payload(p.event, p.app_id, p.channel_id)
+                )
+            self.wal.sync()
+            # ack at the durability point: the WAL holds the records even if
+            # the storage flush below fails or the process dies
+            for p in batch:
+                p.future.set_result(p.event.event_id)
+        items = [(p.event, p.app_id, p.channel_id) for p in batch]
+        if self.wal is None:
+            # no durability layer: ack only after the store has the events,
+            # and surface flush errors to the parked request threads
+            self._l_events().insert_batch(items)
+            for p in batch:
+                p.future.set_result(p.event.event_id)
+            self._observe(batch, time.perf_counter() - t0)
+            return
+        # older failed batches flush first; while any remain, this batch must
+        # park behind them -- checkpointing it now would strand (and GC) the
+        # acked records still awaiting their flush
+        self._flush_retries(force=True)
+        if self._retry_batches:
+            self._park(items, last_seqno, "storage still unavailable")
+        else:
+            try:
+                # "ignore", not "error": ids are assigned pre-WAL precisely so
+                # duplicate application is a no-op. A client-supplied eventId
+                # that already exists dedupes alone instead of aborting the
+                # whole multi-tenant transaction (and it makes crash replay
+                # and client retries idempotent).
+                self._l_events().insert_batch(items, on_duplicate="ignore")
+                self.wal.checkpoint(last_seqno)
+            except Exception as exc:
+                self._park(items, last_seqno, repr(exc))
+        self._observe(batch, time.perf_counter() - t0)
+
+    def _park(self, items: list, last_seqno: int, reason: str) -> None:
+        self._retry_batches.append((items, last_seqno))
+        self._retry_events += len(items)
+        self.storage_errors += 1
+        logger.error(
+            "storage flush failed for %d acked event(s); parked for"
+            " in-process retry (WAL-durable): %s",
+            len(items),
+            reason,
+        )
+
+    def _observe(self, batch: list[_Pending], seconds: float) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.inc(
+            "pio_ingest_events_total",
+            amount=float(len(batch)),
+            help="Events committed through the ingest pipeline",
+        )
+        self.metrics.observe(
+            "pio_ingest_commit_seconds",
+            seconds,
+            help="Group-commit latency (WAL sync + storage flush)",
+        )
+        self.metrics.observe(
+            "pio_ingest_batch_size",
+            float(len(batch)),
+            buckets=BATCH_BUCKETS,
+            help="Events per group commit",
+        )
+        if self.storage_errors:
+            self.metrics.set_counter(
+                "pio_ingest_storage_errors_total",
+                float(self.storage_errors),
+                help="Batches whose storage flush failed (recovered via WAL replay)",
+            )
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the writer. With ``drain`` (default) every queued event is
+        committed first -- the graceful-shutdown contract."""
+        with self._submit_gate:
+            self._stopping.set()
+        if not drain:
+            # reject queued work so request threads don't hang on futures
+            self._reject_queued()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        # belt-and-braces for the join-timeout path (a wedged writer leaves
+        # the queue populated); the submit gate guarantees nothing NEW lands
+        # after the flag, so this cannot race fresh enqueues
+        self._reject_queued()
+
+    def _reject_queued(self) -> None:
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if not p.future.done():
+                p.future.set_exception(IngestOverload(self.retry_after_s))
+
+
+def replay_wal_into_storage(
+    wal: WriteAheadLog, l_events=None, batch_size: int = 500
+) -> int:
+    """Re-apply every un-checkpointed WAL record to the event store;
+    returns the number of records examined. Duplicate records (crash
+    between storage flush and checkpoint) are skipped by the store
+    (``on_duplicate="ignore"``), making replay idempotent."""
+    if l_events is None:
+        from predictionio_tpu.data import storage as storage_registry
+
+        l_events = storage_registry.get_l_events
+    count = 0
+    last_seqno = 0
+    pending: list[tuple[Event, int, int | None]] = []
+
+    def flush() -> None:
+        if pending:
+            l_events().insert_batch(pending, on_duplicate="ignore")
+            pending.clear()
+
+    for seqno, payload in wal.replay():
+        pending.append(_wal_parse(payload))
+        last_seqno = seqno
+        count += 1
+        if len(pending) >= batch_size:
+            flush()
+    flush()
+    if last_seqno:
+        wal.checkpoint(last_seqno)
+    return count

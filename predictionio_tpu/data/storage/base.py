@@ -291,6 +291,33 @@ class LEvents(abc.ABC):
         self, events: Iterable[Event], app_id: int, channel_id: int | None = None
     ) -> list[str]: ...
 
+    def insert_batch(
+        self,
+        items: Iterable[tuple[Event, int, Optional[int]]],
+        on_duplicate: str = "error",
+    ) -> list[str]:
+        """Heterogeneous group commit: ``(event, app_id, channel_id)`` tuples
+        spanning apps/channels, applied as atomically as the backend allows
+        (single transaction on the SQL backends, which override this).
+
+        ``on_duplicate="ignore"`` skips rows whose event_id already exists --
+        the WAL-replay idempotence contract (``data/ingest.py``). This loop
+        fallback serves the non-SQL backends.
+        """
+        if on_duplicate not in ("error", "ignore"):
+            raise ValueError(f"on_duplicate must be error|ignore, got {on_duplicate!r}")
+        ids = []
+        for event, app_id, channel_id in items:
+            ev = event if event.event_id else event.with_id()
+            if (
+                on_duplicate == "ignore"
+                and self.get(ev.event_id, app_id, channel_id) is not None
+            ):
+                ids.append(ev.event_id)
+                continue
+            ids.append(self.insert(ev, app_id, channel_id))
+        return ids
+
     @abc.abstractmethod
     def get(
         self, event_id: str, app_id: int, channel_id: int | None = None

@@ -168,6 +168,8 @@ class StorageClient(sql_common.SQLStorageClient):
         "INSERT INTO models (id, models) VALUES (?, ?)"
         " ON DUPLICATE KEY UPDATE models = VALUES(models)"
     )
+    INSERT_EVENTS_IGNORE_PREFIX = "INSERT IGNORE INTO events"
+    INSERT_EVENTS_IGNORE_SUFFIX = ""
     # MySQL's JSON_TYPE vocabulary is uppercase and splits the numeric kinds
     JSON_NUMBER_EXPR = (
         "CASE WHEN JSON_TYPE(JSON_EXTRACT(properties, ?)) IN"

@@ -137,6 +137,8 @@ class StorageClient(sql_common.SQLStorageClient):
         "INSERT INTO models (id, models) VALUES (?, ?)"
         " ON CONFLICT (id) DO UPDATE SET models = EXCLUDED.models"
     )
+    INSERT_EVENTS_IGNORE_PREFIX = "INSERT INTO events"
+    INSERT_EVENTS_IGNORE_SUFFIX = " ON CONFLICT (app_id, channel_id, event_id) DO NOTHING"
     # properties is TEXT holding JSON; -> / ->> want jsonb and a bare key.
     # jsonb_typeof gate keeps string/bool ratings NULL (from_events parity)
     JSON_NUMBER_EXPR = (

@@ -149,6 +149,12 @@ class SQLStorageClient(base.BaseStorageClient):
     )
     #: upsert into models(id, models)
     UPSERT_MODEL = "INSERT OR REPLACE INTO models (id, models) VALUES (?, ?)"
+    #: events insert that silently skips duplicate (app_id, channel_id,
+    #: event_id) rows -- the WAL-replay idempotence statement. sqlite form
+    #: here; postgres/mysql override. (prefix/suffix split because the
+    #: dialects disagree on where the ignore clause goes.)
+    INSERT_EVENTS_IGNORE_PREFIX = "INSERT OR IGNORE INTO events"
+    INSERT_EVENTS_IGNORE_SUFFIX = ""
     #: dialect JSON extraction over the properties column, NUMBERS ONLY --
     #: NULL for strings/bools/objects, matching EventDataset.from_events'
     #: isinstance(int|float)-and-not-bool rating rule exactly. Placeholders
@@ -597,44 +603,65 @@ class SQLLEvents(base.LEvents):
         )
         return True
 
+    _EVENT_INSERT_COLS = (
+        "(event_id, app_id, channel_id, event,"
+        " entity_type, entity_id, target_entity_type, target_entity_id,"
+        " properties, event_time, event_time_ms, pr_id, creation_time)"
+        " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
+    )
+
+    def _event_row(self, ev: Event, app_id: int, channel_id: int | None) -> tuple:
+        return (
+            ev.event_id,
+            app_id,
+            self._ch(channel_id),
+            ev.event,
+            ev.entity_type,
+            ev.entity_id,
+            ev.target_entity_type,
+            ev.target_entity_id,
+            json.dumps(ev.properties.to_dict()),
+            ev.event_time.isoformat(),
+            ts_ms(ev.event_time),
+            ev.pr_id,
+            ev.creation_time.isoformat(),
+        )
+
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
         return self.batch_insert([event], app_id, channel_id)[0]
 
     def batch_insert(
         self, events: Iterable[Event], app_id: int, channel_id: int | None = None
     ) -> list[str]:
-        ch = self._ch(channel_id)
+        return self.insert_batch((ev, app_id, channel_id) for ev in events)
+
+    def insert_batch(
+        self,
+        items: Iterable[tuple[Event, int, int | None]],
+        on_duplicate: str = "error",
+    ) -> list[str]:
+        """One ``executemany`` (= one transaction on every SQL backend) for a
+        group commit spanning apps/channels -- the ingest pipeline's flush
+        path. ``on_duplicate="error"`` keeps the append-only contract: a
+        duplicate event_id is a caller bug and surfaces as an IntegrityError;
+        ``"ignore"`` is the WAL-replay idempotence mode."""
+        if on_duplicate not in ("error", "ignore"):
+            raise ValueError(f"on_duplicate must be error|ignore, got {on_duplicate!r}")
         rows, ids = [], []
-        for ev in events:
+        for ev, app_id, channel_id in items:
             ev = ev if ev.event_id else ev.with_id()
             ids.append(ev.event_id)
-            rows.append(
-                (
-                    ev.event_id,
-                    app_id,
-                    ch,
-                    ev.event,
-                    ev.entity_type,
-                    ev.entity_id,
-                    ev.target_entity_type,
-                    ev.target_entity_id,
-                    json.dumps(ev.properties.to_dict()),
-                    ev.event_time.isoformat(),
-                    ts_ms(ev.event_time),
-                    ev.pr_id,
-                    ev.creation_time.isoformat(),
-                )
-            )
-        # plain INSERT: the event log is append-only, a duplicate event_id is
-        # a caller bug and must surface as an IntegrityError, not overwrite
+            rows.append(self._event_row(ev, app_id, channel_id))
+        if not rows:
+            return ids
+        prefix = (
+            self.c.INSERT_EVENTS_IGNORE_PREFIX
+            if on_duplicate == "ignore"
+            else "INSERT INTO events"
+        )
+        suffix = self.c.INSERT_EVENTS_IGNORE_SUFFIX if on_duplicate == "ignore" else ""
         self.c.executemany(
-            self.c.sql(
-                "INSERT INTO events (event_id, app_id, channel_id, event,"
-                " entity_type, entity_id, target_entity_type, target_entity_id,"
-                " properties, event_time, event_time_ms, pr_id, creation_time)"
-                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
-            ),
-            rows,
+            self.c.sql(f"{prefix} {self._EVENT_INSERT_COLS}{suffix}"), rows
         )
         return ids
 

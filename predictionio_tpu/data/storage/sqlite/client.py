@@ -101,7 +101,16 @@ class StorageClient(sql_common.SQLStorageClient):
         self._path = path
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
-        self._conn.execute("PRAGMA synchronous=NORMAL")
+        # NORMAL (default) never fsyncs on commit in WAL-journal mode --
+        # fast, but an OS crash can lose recent commits. FULL fsyncs every
+        # commit: the durable per-request baseline the ingestion A/B
+        # (ingest_bench) measures group commit against.
+        sync_mode = config.properties.get("SYNCHRONOUS", "NORMAL").upper()
+        if sync_mode not in ("OFF", "NORMAL", "FULL", "EXTRA"):
+            raise ValueError(
+                f"SYNCHRONOUS must be OFF|NORMAL|FULL|EXTRA, got {sync_mode!r}"
+            )
+        self._conn.execute(f"PRAGMA synchronous={sync_mode}")
         self._lock = threading.RLock()
         with self._lock, self._conn:
             self._conn.executescript(_SCHEMA)

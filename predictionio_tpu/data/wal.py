@@ -1,0 +1,287 @@
+"""Segmented append-only write-ahead log for event ingestion.
+
+The Spark-era reference delegated ingestion durability to external stores
+(HBase WALs, ES translogs); the native rebuild needs its own. This WAL is
+the durability point of the group-commit pipeline (``data/ingest.py``): a
+``POST /events.json`` is acknowledged once its record is framed into the
+current segment and the segment is synced per the fsync policy, and the
+storage flush happens off the request path. On startup, the tail of the
+log past the last storage checkpoint is replayed into the event store.
+
+On-disk layout (one directory per log)::
+
+    wal-00000000000000000001.log   segment files, named by FIRST seqno
+    wal-00000000000000004096.log
+    wal.ckpt                       last seqno known flushed to storage
+
+Record frame (little-endian): ``uint32 payload_len | uint32 crc32 |
+uint64 seqno | payload``, where the CRC covers the seqno bytes plus the
+payload. A torn tail (partial frame, bad CRC, or an impossible length from
+a crash mid-append) terminates the scan of that segment only; every
+restart opens a fresh segment -- or, when the crash tore the very first
+frame (so the restart re-derives the same segment name), truncates the
+torn garbage first -- so intact records are never hidden behind a torn
+frame.
+
+Fsync policy trade-off (``always`` | ``interval`` | ``never``):
+
+- ``always``  -- fsync on every :meth:`sync` (one per group commit, NOT
+  one per record: the pipeline amortizes it over the batch);
+- ``interval``-- fsync at most once per ``fsync_interval_ms``; bounds the
+  post-crash loss window to that interval;
+- ``never``   -- OS page cache only; survives process death, not host
+  death.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import zlib
+
+#: frame header: payload length, crc32(seqno_bytes + payload), seqno
+_FRAME = struct.Struct("<IIQ")
+
+#: sanity ceiling on a single record; a longer length field means the
+#: header bytes are garbage from a torn write, not a real record
+MAX_RECORD_BYTES = 64 << 20
+
+FSYNC_POLICIES = ("always", "interval", "never")
+
+_SEGMENT_PREFIX = "wal-"
+_SEGMENT_SUFFIX = ".log"
+_CHECKPOINT_FILE = "wal.ckpt"
+
+
+def _segment_name(first_seqno: int) -> str:
+    return f"{_SEGMENT_PREFIX}{first_seqno:020d}{_SEGMENT_SUFFIX}"
+
+
+def _segment_first_seqno(name: str) -> int | None:
+    if not (name.startswith(_SEGMENT_PREFIX) and name.endswith(_SEGMENT_SUFFIX)):
+        return None
+    digits = name[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)]
+    return int(digits) if digits.isdigit() else None
+
+
+def _scan_segment(path: str):
+    """Yield ``(seqno, payload)`` for every intact frame; stop at the first
+    torn or corrupt one (crash mid-append leaves at most one)."""
+    for _, seqno, payload in _scan_frames(path):
+        yield seqno, payload
+
+
+def _scan_frames(path: str):
+    """Like :func:`_scan_segment` but also yields each frame's end offset,
+    so callers can truncate a torn tail."""
+    offset = 0
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(_FRAME.size)
+            if len(header) < _FRAME.size:
+                return  # clean EOF or torn header
+            length, crc, seqno = _FRAME.unpack(header)
+            if length > MAX_RECORD_BYTES:
+                return  # garbage length: torn frame
+            payload = f.read(length)
+            if len(payload) < length:
+                return  # torn payload
+            if zlib.crc32(header[8:] + payload) != crc:
+                return  # bit rot / torn rewrite
+            offset += _FRAME.size + length
+            yield offset, seqno, payload
+
+
+def _valid_prefix_length(path: str) -> int:
+    """Byte length of the intact-frame prefix (0 for a fully torn file)."""
+    end = 0
+    for end, _, _ in _scan_frames(path):
+        pass
+    return end
+
+
+class WriteAheadLog:
+    """Thread-safe via an internal lock; the ingest pipeline is the single
+    writer in practice, but replay/checkpoint may come from other threads."""
+
+    def __init__(
+        self,
+        directory: str,
+        segment_bytes: int = 64 << 20,
+        fsync_policy: str = "always",
+        fsync_interval_ms: float = 100.0,
+    ):
+        if fsync_policy not in FSYNC_POLICIES:
+            raise ValueError(
+                f"fsync_policy must be one of {FSYNC_POLICIES}, got {fsync_policy!r}"
+            )
+        self.directory = directory
+        self.segment_bytes = segment_bytes
+        self.fsync_policy = fsync_policy
+        self.fsync_interval_s = fsync_interval_ms / 1000.0
+        self._lock = threading.Lock()
+        self._last_fsync = 0.0
+        # collectible segments only appear on rotation (and at startup,
+        # where prior-run segments may be replay-covered): gate GC on that
+        # instead of paying a directory listing per group commit
+        self._rotated_since_gc = True
+        os.makedirs(directory, exist_ok=True)
+        # the checkpoint is read once and cached: it only ever advances
+        # through this instance, and a stale on-disk value is safe by design
+        self._committed = self._read_checkpoint()
+        # recover the seqno cursor: one past the last intact record anywhere
+        # in the log (the checkpoint can trail behind after a crash)
+        last = self._committed
+        for path in self._segments():
+            for seqno, _ in _scan_segment(path):
+                if seqno > last:
+                    last = seqno
+        self._next_seqno = last + 1
+        # always a fresh segment: appending after a torn frame would make the
+        # torn bytes look like a mid-file corruption and hide the new records
+        self._file = None
+        self._segment_size = 0
+        self._open_segment()
+
+    # -- segments -----------------------------------------------------------
+    def _segments(self) -> list[str]:
+        names = [
+            n
+            for n in os.listdir(self.directory)
+            if _segment_first_seqno(n) is not None
+        ]
+        names.sort()  # zero-padded first-seqno names sort chronologically
+        return [os.path.join(self.directory, n) for n in names]
+
+    def _open_segment(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+            if self.fsync_policy != "never":
+                os.fsync(self._file.fileno())
+            self._file.close()
+        path = os.path.join(self.directory, _segment_name(self._next_seqno))
+        # name collision means the existing file holds NO intact records
+        # (any intact record would have advanced the seqno scan past this
+        # name): a torn first frame from a crash mid-append. Appending after
+        # torn bytes would hide the new records from replay -- truncate the
+        # garbage away first.
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size:
+            valid = _valid_prefix_length(path)
+            if valid < size:
+                with open(path, "r+b") as f:
+                    f.truncate(valid)
+        self._file = open(path, "ab")
+        self._segment_size = self._file.tell()
+        self._rotated_since_gc = True
+
+    # -- write path ----------------------------------------------------------
+    def append(self, payload: bytes) -> int:
+        """Frame and buffer one record; returns its seqno. Durability comes
+        from the following :meth:`sync` (the group-commit boundary)."""
+        with self._lock:
+            frame_len = _FRAME.size + len(payload)
+            # rotate BEFORE taking the seqno so the fresh segment's name
+            # equals its first record's seqno (the layout invariant _gc and
+            # replay lower-bounding rely on)
+            if self._segment_size + frame_len > self.segment_bytes and self._segment_size:
+                self._open_segment()
+            seqno = self._next_seqno
+            self._next_seqno += 1
+            seq_bytes = struct.pack("<Q", seqno)
+            frame = (
+                _FRAME.pack(len(payload), zlib.crc32(seq_bytes + payload), seqno)
+                + payload
+            )
+            self._file.write(frame)
+            self._segment_size += frame_len
+            return seqno
+
+    def sync(self) -> None:
+        """Make buffered records durable per the fsync policy."""
+        with self._lock:
+            self._file.flush()
+            if self.fsync_policy == "always":
+                os.fsync(self._file.fileno())
+            elif self.fsync_policy == "interval":
+                now = time.monotonic()
+                if now - self._last_fsync >= self.fsync_interval_s:
+                    os.fsync(self._file.fileno())
+                    self._last_fsync = now
+
+    # -- checkpoint / replay --------------------------------------------------
+    def _read_checkpoint(self) -> int:
+        try:
+            with open(os.path.join(self.directory, _CHECKPOINT_FILE)) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def committed(self) -> int:
+        """Last seqno known flushed to storage (0 = nothing)."""
+        return self._committed
+
+    def checkpoint(self, seqno: int) -> None:
+        """Advance the storage high-water mark; periodically drop fully-
+        covered segments. This runs once per group commit, so it stays
+        cheap: no fsync (the checkpoint is an optimization hint -- a stale
+        or torn one after a crash only means extra idempotent replay, never
+        loss) and segment GC is amortized."""
+        with self._lock:
+            if seqno <= self._committed:
+                return
+            self._committed = seqno
+            path = os.path.join(self.directory, _CHECKPOINT_FILE)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(seqno))
+            os.replace(tmp, path)
+            if self._rotated_since_gc:
+                self._rotated_since_gc = False
+                self._gc(seqno)
+
+    def _gc(self, committed: int) -> None:
+        segments = self._segments()
+        current = os.path.join(
+            self.directory, os.path.basename(self._file.name)
+        )
+        for path, next_path in zip(segments, segments[1:]):
+            if path == current:
+                continue
+            next_first = _segment_first_seqno(os.path.basename(next_path))
+            # every record in `path` has seqno < next_first; fully committed
+            # segments are dead weight
+            if next_first is not None and next_first - 1 <= committed:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    def replay(self):
+        """Yield ``(seqno, payload)`` for every record past the checkpoint,
+        in seqno order. Safe against torn tails; duplicate delivery is
+        possible (crash between storage flush and checkpoint), so consumers
+        must apply records idempotently."""
+        committed = self.committed()
+        for path in self._segments():
+            for seqno, payload in _scan_segment(path):
+                if seqno > committed:
+                    yield seqno, payload
+
+    def pending(self) -> int:
+        """Count of un-checkpointed records on disk (replay cost estimate)."""
+        return sum(1 for _ in self.replay())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                if self.fsync_policy != "never":
+                    os.fsync(self._file.fileno())
+                self._file.close()
+                self._file = None

@@ -12,6 +12,31 @@ def register(sub: argparse._SubParsersAction) -> None:
     es.add_argument("--stats", action="store_true", help="enable /stats.json")
     es.add_argument("--ssl-cert", default=None, help="PEM cert: serve HTTPS")
     es.add_argument("--ssl-key", default=None, help="PEM key (if not in cert)")
+    es.add_argument(
+        "--plugin", action="append", default=[], metavar="MODULE:CLASS",
+        help="EventServerPlugin to load (repeatable), e.g. my.mod:MyBlocker",
+    )
+    es.add_argument(
+        "--ingest-mode", choices=("sync", "wal"), default="sync",
+        help="sync: one storage commit per request (default);"
+        " wal: durable WAL ack + background group commit",
+    )
+    es.add_argument(
+        "--ingest-queue-size", type=int, default=2048,
+        help="bounded ingest queue; a full queue returns 429 (wal mode)",
+    )
+    es.add_argument(
+        "--group-commit-ms", type=float, default=5.0,
+        help="max wait to grow a commit batch (wal mode)",
+    )
+    es.add_argument(
+        "--fsync-policy", choices=("always", "interval", "never"),
+        default="always", help="WAL durability vs throughput trade-off",
+    )
+    es.add_argument(
+        "--wal-dir", default=None,
+        help="WAL directory (default $PIO_FS_BASEDIR/wal)",
+    )
     es.set_defaults(func=cmd_eventserver)
 
     db = sub.add_parser("dashboard", help="start the evaluation dashboard")
@@ -28,12 +53,38 @@ def register(sub: argparse._SubParsersAction) -> None:
     shell.set_defaults(func=cmd_shell)
 
 
+def load_plugins(specs: list[str]) -> list:
+    """Instantiate ``module.path:ClassName`` EventServerPlugin specs."""
+    import importlib
+
+    plugins = []
+    for spec in specs:
+        module_path, sep, class_name = spec.partition(":")
+        if not sep or not module_path or not class_name:
+            raise SystemExit(f"--plugin {spec!r}: expected MODULE:CLASS")
+        try:
+            cls = getattr(importlib.import_module(module_path), class_name)
+        except (ImportError, AttributeError) as exc:
+            raise SystemExit(f"--plugin {spec!r}: {exc}")
+        plugins.append(cls())
+    return plugins
+
+
 def cmd_eventserver(args: argparse.Namespace) -> int:
     from predictionio_tpu.data.api.eventserver import run_event_server
+    from predictionio_tpu.data.ingest import IngestConfig
 
     run_event_server(
         host=args.ip, port=args.port, stats=args.stats,
         ssl_cert=args.ssl_cert, ssl_key=args.ssl_key,
+        plugins=load_plugins(args.plugin),
+        ingest_config=IngestConfig(
+            mode=args.ingest_mode,
+            queue_size=args.ingest_queue_size,
+            group_commit_ms=args.group_commit_ms,
+            fsync_policy=args.fsync_policy,
+            wal_dir=args.wal_dir,
+        ),
     )
     return 0
 

@@ -14,7 +14,7 @@ import re
 import threading
 import time
 import traceback
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
@@ -44,6 +44,8 @@ class Response:
     status: int = 200
     body: Any = None
     content_type: str = "application/json; charset=utf-8"
+    #: extra response headers (e.g. Retry-After on 429 backpressure)
+    headers: dict[str, str] = field(default_factory=dict)
 
     def payload(self) -> bytes:
         if self.body is None:
@@ -218,6 +220,8 @@ def make_server(
             self.send_header("Content-Length", str(len(payload)))
             for k, v in _CORS_HEADERS.items():
                 self.send_header(k, v)
+            for k, v in response.headers.items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(payload)
 
@@ -249,10 +253,15 @@ def make_server(
 
 
 class ServiceThread:
-    """Run an HTTP server on a daemon thread (tests / embedded use)."""
+    """Run an HTTP server on a daemon thread (tests / embedded use).
 
-    def __init__(self, server: ThreadingHTTPServer):
+    ``on_stop`` runs after the listener closes -- the hook services use to
+    drain background pipelines (e.g. the event server's ingest writer).
+    """
+
+    def __init__(self, server: ThreadingHTTPServer, on_stop: Callable[[], None] | None = None):
         self.server = server
+        self.on_stop = on_stop
         self._thread = threading.Thread(target=server.serve_forever, daemon=True)
 
     @property
@@ -266,3 +275,5 @@ class ServiceThread:
     def stop(self) -> None:
         self.server.shutdown()
         self.server.server_close()
+        if self.on_stop is not None:
+            self.on_stop()

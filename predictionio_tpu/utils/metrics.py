@@ -45,6 +45,8 @@ class MetricsRegistry:
         self._help: dict[str, str] = {}
         # name -> {sorted-label-tuple -> float}
         self._counters: dict[str, dict[tuple, float]] = {}
+        # name -> {sorted-label-tuple -> float}; set-to-value semantics
+        self._gauges: dict[str, dict[tuple, float]] = {}
         # name -> (buckets, {sorted-label-tuple -> [bucket counts..., sum, count]})
         self._histograms: dict[str, tuple[tuple, dict[tuple, list]]] = {}
 
@@ -76,6 +78,21 @@ class MetricsRegistry:
             if help:
                 self._help.setdefault(name, help)
             self._counters.setdefault(name, {})[key] = float(value)
+
+    def set_gauge(
+        self,
+        name: str,
+        value: float,
+        labels: Mapping[str, str] | None = None,
+        help: str = "",
+    ) -> None:
+        """Point-in-time value (queue depth, pool size): exposed with TYPE
+        gauge so scrapers don't apply rate() to it."""
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            if help:
+                self._help.setdefault(name, help)
+            self._gauges.setdefault(name, {})[key] = float(value)
 
     def observe(
         self,
@@ -110,6 +127,12 @@ class MetricsRegistry:
                 for key, value in sorted(series.items()):
                     # .17g, not %g: %g rounds to 6 significant digits, which
                     # freezes large counters between scrapes and breaks rate()
+                    lines.append(f"{name}{_fmt_labels(dict(key))} {value:.17g}")
+            for name, series in sorted(self._gauges.items()):
+                if name in self._help:
+                    lines.append(f"# HELP {name} {self._help[name]}")
+                lines.append(f"# TYPE {name} gauge")
+                for key, value in sorted(series.items()):
                     lines.append(f"{name}{_fmt_labels(dict(key))} {value:.17g}")
             for name, (buckets, series) in sorted(self._histograms.items()):
                 if name in self._help:

@@ -138,6 +138,22 @@ class TestQueryAndStats:
             f"{base}/events.json", params={"accessKey": key, "limit": "zz"}
         ).status_code == 400
 
+    def test_limit_minus_one_means_unlimited(self, server):
+        base, key = server
+        batch = [
+            {"event": "view", "entityType": "user", "entityId": f"u{i}"}
+            for i in range(25)
+        ]
+        requests.post(f"{base}/batch/events.json", params={"accessKey": key}, json=batch)
+        q = lambda **p: requests.get(
+            f"{base}/events.json", params={"accessKey": key, **p}
+        )
+        assert len(q().json()) == 20           # absent -> default page
+        assert len(q(limit="-1").json()) == 25  # -1 -> unlimited (upstream parity)
+        assert len(q(limit="3").json()) == 3
+        assert len(q(limit="0").json()) == 0
+        assert q(limit="-2").status_code == 400
+
     def test_stats(self, server):
         base, key = server
         requests.post(f"{base}/events.json", params={"accessKey": key}, json=VALID)
@@ -190,7 +206,9 @@ class TestWhitelistAndPlugins:
             def input_sniffer(self, event, app_id, channel_id):
                 seen.append(event.entity_id)
 
-        svc = create_event_server(host="127.0.0.1", port=0, plugins=[Blocker()]).start()
+        svc = create_event_server(
+            host="127.0.0.1", port=0, stats=True, plugins=[Blocker()]
+        ).start()
         base = f"http://127.0.0.1:{svc.port}"
         try:
             ok = requests.post(
@@ -204,8 +222,42 @@ class TestWhitelistAndPlugins:
             )
             assert blocked.status_code == 403
             assert seen == ["fine"]
+            # /stats.json reflects plugin-blocked events, not just 201/400
+            stats = requests.get(f"{base}/stats.json").json()
+            events = stats["appStatistics"][0]["events"]
+            assert {"event": "view", "status": 403, "count": 1} in events
         finally:
             svc.stop()
+
+    def test_run_event_server_plumbs_plugins(self, storage_env, monkeypatch):
+        """The blocking entry point must not drop its plugin list."""
+        from predictionio_tpu.data.api import eventserver as es_mod
+
+        captured = {}
+
+        class _FakeServer:
+            def serve_forever(self):
+                raise KeyboardInterrupt
+
+            def server_close(self):
+                pass
+
+        def fake_make_server(router, *a, **k):
+            captured["router"] = router
+            return _FakeServer()
+
+        monkeypatch.setattr(es_mod, "make_server", fake_make_server)
+        built = {}
+        orig_init = es_mod.EventService.__init__
+
+        def spy_init(self, *a, **k):
+            orig_init(self, *a, **k)
+            built["service"] = self
+
+        monkeypatch.setattr(es_mod.EventService, "__init__", spy_init)
+        plugin = EventServerPlugin()
+        es_mod.run_event_server(port=0, plugins=[plugin])
+        assert built["service"].plugins == [plugin]
 
 
 class TestWebhooks:

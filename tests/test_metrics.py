@@ -43,6 +43,16 @@ class TestRegistry:
     def test_default_buckets_cover_sub_ms_to_slow(self):
         assert DEFAULT_BUCKETS[0] <= 0.0005 and DEFAULT_BUCKETS[-1] >= 10
 
+    def test_gauge_set_to_value_semantics(self):
+        m = MetricsRegistry()
+        m.set_gauge("queue_depth", 7, {"svc": "ingest"}, help="depth")
+        m.set_gauge("queue_depth", 3, {"svc": "ingest"})
+        text = m.exposition()
+        assert "# HELP queue_depth depth" in text
+        assert "# TYPE queue_depth gauge" in text
+        assert 'queue_depth{svc="ingest"} 3' in text
+        assert 'queue_depth{svc="ingest"} 7' not in text
+
 
 def _get(url: str) -> tuple[int, str]:
     with urllib.request.urlopen(url, timeout=10) as resp:
