@@ -380,12 +380,33 @@ def secondary_main(result_path: str) -> None:
             "config": "#7 ingest_eps (32 writers, sqlite, fsync=always)",
         }
 
+    def train_data_eps():
+        """#8: training-data extraction events/sec, cold two-scan SQL read
+        vs columnar-snapshot memmap replay (sqlite), plus the
+        refresh-then-train bit-identity check. Sizes are trimmed for the
+        secondary budget; the full-size (2M-event) A/B is
+        `python -m predictionio_tpu.tools.train_bench`."""
+        from predictionio_tpu.tools.train_bench import run_ab
+
+        rep = run_ab(
+            events=120_000, users=8_000, items=2_000, identity_events=20_000
+        )
+        return {
+            "eps_cold_scan": rep["cold"]["eps"],
+            "eps_snapshot_replay": rep["replay"]["eps"],
+            "eps_speedup": rep["eps_speedup"],
+            "snapshot_build_seconds": rep["snapshot_build"]["seconds"],
+            "refresh_bit_identical": rep["refresh_identity"]["bit_identical"],
+            "config": "#8 train_data_eps (120k events, sqlite, 2-pass read)",
+        }
+
     phase("naive_bayes_fit", nb_fit)
     phase("logreg_lbfgs_fit", logreg_fit)
     phase("cooccurrence_llr_indicators", cooc_indicators)
     phase("ncf_batchpredict", ncf_batchpredict)
     phase("serving_qps", serving_qps)
     phase("ingest_eps", ingest_eps)
+    phase("train_data_eps", train_data_eps)
 
 
 def child_main(mode: str, result_path: str) -> None:

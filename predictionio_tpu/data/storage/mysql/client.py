@@ -176,6 +176,9 @@ class StorageClient(sql_common.SQLStorageClient):
         " ('INTEGER', 'DOUBLE', 'DECIMAL', 'UNSIGNED INTEGER')"
         " THEN JSON_EXTRACT(properties, ?) END"
     )
+    # MOD(), not the % operator: pymysql/mysqlclient %-interpolation would
+    # eat a bare % in statement text (same truncated semantics)
+    TIME_MOD_EXPR = "MOD(event_time_ms, {mod})"
 
     def __init__(self, config: StorageClientConfig):
         super().__init__(config)

@@ -145,6 +145,9 @@ class StorageClient(sql_common.SQLStorageClient):
         "CASE WHEN jsonb_typeof(properties::jsonb -> ?) = 'number'"
         " THEN (properties::jsonb ->> ?) END"
     )
+    # MOD(), not the % operator: psycopg2's client-side interpolation
+    # would eat a bare % in statement text (same truncated semantics)
+    TIME_MOD_EXPR = "MOD(event_time_ms, {mod})"
 
     @classmethod
     def json_number_params(cls, key: str) -> tuple:

@@ -164,6 +164,13 @@ class SQLStorageClient(base.BaseStorageClient):
         "CASE WHEN json_type(properties, ?) IN ('integer', 'real')"
         " THEN json_extract(properties, ?) END"
     )
+    #: dialect modulo over event_time_ms ({mod} formatted in) -- the
+    #: snapshot digest's per-row checksum term. sqlite has only the ``%``
+    #: operator (MOD() needs a math-functions build); the %s-paramstyle
+    #: dialects override with MOD(): a bare ``%`` in statement text would
+    #: be eaten by psycopg2/pymysql's client-side interpolation. All three
+    #: forms use TRUNCATED (sign-of-dividend) semantics.
+    TIME_MOD_EXPR = "event_time_ms % {mod}"
 
     @classmethod
     def json_number_params(cls, key: str) -> tuple:
@@ -830,6 +837,71 @@ class SQLLEvents(base.LEvents):
             for acc, part in zip(cols, chunk):
                 acc.extend(part)
         return cols
+
+    def count_interactions(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        event_names: list[str] | None = None,
+        target_entity_type=...,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+    ) -> int:
+        """Row count of one bounded interaction scan -- a single SQL
+        aggregate, no row transfer. The snapshot layer uses it to verify
+        that a snapshot's covered prefix still matches the event table
+        (late-arriving or deleted events force a full rebuild instead of
+        an inexact append refresh). Shares find()/scan_interactions()'s
+        filter builder so the three paths cannot disagree on semantics.
+        """
+        sql = ["SELECT COUNT(*) FROM events WHERE app_id=? AND channel_id=?"]
+        params: list = [app_id, self._ch(channel_id)]
+        self._append_filters(
+            sql,
+            params,
+            start_time=start_time,
+            until_time=until_time,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+        )
+        return int(self.c.query(self.c.sql(" ".join(sql)), tuple(params))[0][0])
+
+    def interaction_digest(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        event_names: list[str] | None = None,
+        target_entity_type=...,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+    ) -> tuple[int, int]:
+        """``(row count, sum of event_time_ms %% TIME_DIGEST_MOD)`` over one
+        bounded scan -- a single aggregate query, no row transfer. The
+        snapshot refresh path compares it against the digest accumulated
+        at spill time: a deletion balanced by a late-arriving insert keeps
+        the COUNT but (outside sum collisions) not the time checksum, so
+        an inexact append refresh is caught and rebuilt instead. The
+        per-row modulus keeps the sum exact in any dialect's 64-bit
+        integer SUM (no bigint overflow / float fallback).
+        """
+        from predictionio_tpu.data.snapshot import TIME_DIGEST_MOD
+
+        mod_expr = self.c.TIME_MOD_EXPR.format(mod=TIME_DIGEST_MOD)
+        sql = [
+            f"SELECT COUNT(*), COALESCE(SUM({mod_expr}), 0)"
+            " FROM events WHERE app_id=? AND channel_id=?"
+        ]
+        params: list = [app_id, self._ch(channel_id)]
+        self._append_filters(
+            sql,
+            params,
+            start_time=start_time,
+            until_time=until_time,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+        )
+        row = self.c.query(self.c.sql(" ".join(sql)), tuple(params))[0]
+        return int(row[0]), int(row[1])
 
     def iter_interaction_chunks(
         self,

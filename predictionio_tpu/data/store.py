@@ -190,6 +190,29 @@ class EventDataset:
             ratings=ratings,
         )
 
+    @classmethod
+    def from_snapshot(cls, snapshot) -> "EventDataset":
+        """Build from a columnar training snapshot (``data/snapshot``) --
+        zero SQL, zero parsing: the snapshot already holds exactly this
+        class's encoding (full-stream first-appearance vocabularies, -1
+        sentinel targets, float64 epoch times, NaN-for-absent ratings).
+        Columns are copied out of the memmaps so the dataset outlives the
+        snapshot files (a later refresh GCs old generations).
+        """
+        return cls(
+            events=[],
+            entity_id_vocab=list(snapshot.vocab("users")),
+            target_entity_id_vocab=list(snapshot.vocab("items")),
+            event_name_vocab=list(snapshot.vocab("names")),
+            entity_ids=np.asarray(snapshot.column("users")).astype(np.int32),
+            target_entity_ids=np.asarray(snapshot.column("items")).astype(
+                np.int32
+            ),
+            event_names=np.array(snapshot.column("names"), np.int32),
+            event_times=np.array(snapshot.column("times"), np.float64),
+            ratings=np.asarray(snapshot.column("ratings")).astype(np.float32),
+        )
+
 
 class LEventStore:
     """Blocking serving-time event reads, resolved by app name."""
@@ -276,14 +299,34 @@ class PEventStore:
         {"event_names", "target_entity_type", "start_time", "until_time"}
     )
 
+    #: dataset() filters a training snapshot can key on (time filters are
+    #: excluded: a snapshot's coverage boundary is its own until bound)
+    _SNAPSHOT_FILTERS = frozenset({"event_names", "target_entity_type"})
+
     @staticmethod
     def dataset(
         app_name: str,
         rating_key: str = "rating",
         channel_name: str | None = None,
+        snapshot_mode: str | None = None,
+        snapshot_dir: str | None = None,
         **kwargs,
     ) -> EventDataset:
+        """Columnar training read. With snapshots enabled (explicit args,
+        ``pio.snapshot_*`` runtime conf via ``pio train``, or the
+        ``PIO_SNAPSHOT_MODE``/``PIO_SNAPSHOT_DIR`` env), a compatible
+        query is served from the on-disk training snapshot: ``use`` mode
+        replays the existing spill as-is (bounded at ITS time coverage --
+        stale-but-fast by contract), ``refresh`` first appends the events
+        since. Everything else falls through to the live scan paths.
+        """
         le = storage_registry.get_l_events()
+        ds = PEventStore._dataset_from_snapshot(
+            le, app_name, rating_key, channel_name,
+            snapshot_mode, snapshot_dir, kwargs,
+        )
+        if ds is not None:
+            return ds
         if (
             hasattr(le, "scan_interactions")
             and set(kwargs) <= PEventStore._FAST_SCAN_FILTERS
@@ -310,6 +353,50 @@ class PEventStore:
             PEventStore.find(app_name, channel_name=channel_name, **kwargs),
             rating_key=rating_key,
         )
+
+    @staticmethod
+    def _dataset_from_snapshot(
+        le, app_name, rating_key, channel_name, snapshot_mode, snapshot_dir,
+        kwargs,
+    ) -> EventDataset | None:
+        """The snapshot-served fast path of :meth:`dataset`, or None when
+        snapshots are off / the query or backend is incompatible / the
+        snapshot layer fails (training must degrade to the scan)."""
+        from predictionio_tpu.data.snapshot import (
+            SnapshotSpec,
+            SnapshotStore,
+            snapshot_settings,
+        )
+
+        mode, root = snapshot_settings(
+            mode=snapshot_mode, snapshot_dir=snapshot_dir
+        )
+        if mode == "off" or not set(kwargs) <= PEventStore._SNAPSHOT_FILTERS:
+            return None
+        if not hasattr(le, "iter_interaction_chunks"):
+            return None
+        try:
+            app_id, channel_id = resolve_app_channel(app_name, channel_name)
+            event_names = kwargs.get("event_names")
+            spec = SnapshotSpec(
+                app_id=app_id,
+                channel_id=channel_id,
+                event_names=tuple(event_names) if event_names else None,
+                rating_key=rating_key,
+                target_entity_type=kwargs.get("target_entity_type", ...),
+            )
+            snap = SnapshotStore(root, spec).ensure(le, mode)
+            if snap is None:
+                return None
+            return EventDataset.from_snapshot(snap)
+        except Exception:
+            logger.warning(
+                "snapshot-served dataset failed for app %r; falling back to"
+                " the live scan",
+                app_name,
+                exc_info=True,
+            )
+            return None
 
     @staticmethod
     def aggregate_properties(

@@ -10,6 +10,7 @@ index mapping) stays in the engines.
 
 from __future__ import annotations
 
+import datetime as _dt
 import logging
 from dataclasses import dataclass, field
 
@@ -43,6 +44,14 @@ class StreamingHandle(SanityCheck):
     #: params separate, so values configured on the datasource must ride
     #: the handle to reach the streaming build
     extras: dict = field(default_factory=dict)
+    #: EXCLUSIVE scan bound captured when the handle is created: every
+    #: pass on every process reads the identical event prefix, so writes
+    #: landing mid-train can neither crash pass 2 (an entity pass 1 never
+    #: counted) nor make multi-host processes derive divergent layouts.
+    #: It is also the snapshot layer's coverage boundary.
+    until_time: _dt.datetime = field(
+        default_factory=lambda: _dt.datetime.now(_dt.timezone.utc)
+    )
 
     def sanity_check(self) -> None:
         from predictionio_tpu.data import storage
@@ -143,25 +152,120 @@ def live_seen_indices(model, user: str, cache: dict | None = None) -> set[int]:
     return out
 
 
-def build_streaming_als(handle: StreamingHandle, preparator_params, mesh,
-                        event_values: dict[str, float] | None = None):
-    """The shared streaming ALS build both ALS-family templates run:
-    chunked store scan -> retention-bounded sharded pack. Returns
-    ``(users_enc, items_enc, als_data)``; the caller assembles its own
-    template-specific data carrier around the vocabularies.
-    """
+def _agree_until_time(handle: StreamingHandle) -> None:
+    """Multi-process launches: adopt rank 0's captured scan bound.
+
+    Each process captures ``until_time`` at its own handle creation, so
+    wall-clock skew between launches would bound their scans differently
+    -- exactly the divergent-layout bug the bound exists to kill. The
+    bound is broadcast as integer microseconds and reconstructed with
+    integer arithmetic, so every process derives a bit-identical datetime
+    (and therefore an identical ``event_time_ms`` cutoff)."""
+    until = getattr(handle, "until_time", None)
+    if until is None:
+        return
+    try:
+        import jax
+
+        if jax.process_count() <= 1:
+            return
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        local_us = int(until.timestamp() * 1e6)
+        agreed_us = int(
+            multihost_utils.broadcast_one_to_all(np.int64(local_us))
+        )
+        # EVERY rank adopts the reconstructed value -- rank 0 included:
+        # int(timestamp()*1e6) can truncate 1us below the original
+        # datetime, so keeping the original on rank 0 could still put its
+        # ms cutoff one ahead of everyone else's at a boundary
+        handle.until_time = _dt.datetime.fromtimestamp(
+            agreed_us // 10**6, tz=_dt.timezone.utc
+        ) + _dt.timedelta(microseconds=agreed_us % 10**6)
+    except Exception:
+        logger.warning(
+            "could not agree on a cross-process scan bound; using the"
+            " local one",
+            exc_info=True,
+        )
+
+
+def _snapshot_for_handle(handle: StreamingHandle, runtime_conf):
+    """The handle's ready training snapshot, or None (mode off, backend
+    without the columnar scan, or any snapshot-layer failure -- training
+    must degrade to the direct scan, never die on a cache)."""
     from predictionio_tpu.data import storage
-    from predictionio_tpu.parallel.als import ALSConfig
+    from predictionio_tpu.data.snapshot import (
+        SnapshotSpec,
+        SnapshotStore,
+        snapshot_settings,
+    )
+
+    mode, root = snapshot_settings(runtime_conf)
+    if mode == "off":
+        return None
+    if mode == "use":
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                # per-host snapshot state differs (local disks, different
+                # build histories); "use" would let each process replay a
+                # DIFFERENT prefix. "refresh" converges every process onto
+                # the agreed bound exactly -- same rows, same layout.
+                logger.info(
+                    "multi-process launch: snapshot mode 'use' escalated to"
+                    " 'refresh' so every process replays the agreed bound"
+                )
+                mode = "refresh"
+        except Exception:
+            pass
+    le = storage.get_l_events()
+    spec = SnapshotSpec(
+        app_id=handle.app_id,
+        channel_id=handle.channel_id,
+        event_names=tuple(handle.event_names) if handle.event_names else None,
+        rating_key=handle.rating_key,
+    )
+    try:
+        return SnapshotStore(root, spec).ensure(
+            le,
+            mode,
+            until_time=getattr(handle, "until_time", None),
+            chunk_rows=handle.chunk_rows,
+        )
+    except Exception:
+        logger.warning(
+            "training snapshot unavailable for app %r; falling back to the"
+            " direct store scan",
+            handle.app_name,
+            exc_info=True,
+        )
+        return None
+
+
+def streaming_coo_source(
+    handle: StreamingHandle,
+    runtime_conf=None,
+    event_values: dict[str, float] | None = None,
+):
+    """(source, users_enc, items_enc) for a handle: snapshot-served memmap
+    replay when ``--snapshot-mode`` enables it, else the bounded store
+    scan. Both yield bit-identical chunk streams over the same prefix."""
+    from predictionio_tpu.data import storage
     from predictionio_tpu.parallel.reader import (
-        build_als_data_sharded,
+        snapshot_coo_chunks,
         store_coo_chunks,
     )
 
-    config = ALSConfig(
-        max_len=preparator_params.get_or("maxEventsPerUser", None),
-        buckets=preparator_params.get_or("buckets", 1),
-    )
-    source, users_enc, items_enc = store_coo_chunks(
+    _agree_until_time(handle)
+    snap = _snapshot_for_handle(handle, runtime_conf)
+    if snap is not None:
+        return snapshot_coo_chunks(
+            snap, chunk_rows=handle.chunk_rows, event_values=event_values
+        )
+    return store_coo_chunks(
         storage.get_l_events(),
         handle.app_id,
         channel_id=handle.channel_id,
@@ -169,6 +273,59 @@ def build_streaming_als(handle: StreamingHandle, preparator_params, mesh,
         rating_key=handle.rating_key,
         chunk_rows=handle.chunk_rows,
         event_values=event_values,
+        until_time=getattr(handle, "until_time", None),
+    )
+
+
+def streaming_multi_event_sources(handle: StreamingHandle, runtime_conf=None):
+    """Per-event-type sources over one shared universe (the UR build):
+    snapshot replay when enabled, else the bounded multi-type store scan.
+    Returns ``(sources, users_enc, items_enc, universe_ready)`` --
+    ``universe_ready`` is True when the encoders are already complete
+    (snapshot replay), letting the caller skip the priming scan."""
+    from predictionio_tpu.data import storage
+    from predictionio_tpu.parallel.reader import (
+        snapshot_multi_event_chunks,
+        store_multi_event_chunks,
+    )
+
+    _agree_until_time(handle)
+    snap = _snapshot_for_handle(handle, runtime_conf)
+    if snap is not None:
+        sources, users_enc, items_enc = snapshot_multi_event_chunks(
+            snap, handle.event_names, chunk_rows=handle.chunk_rows
+        )
+        return sources, users_enc, items_enc, True
+    sources, users_enc, items_enc = store_multi_event_chunks(
+        storage.get_l_events(),
+        handle.app_id,
+        handle.event_names,
+        channel_id=handle.channel_id,
+        chunk_rows=handle.chunk_rows,
+        until_time=getattr(handle, "until_time", None),
+    )
+    return sources, users_enc, items_enc, False
+
+
+def build_streaming_als(handle: StreamingHandle, preparator_params, mesh,
+                        event_values: dict[str, float] | None = None,
+                        runtime_conf=None):
+    """The shared streaming ALS build both ALS-family templates run:
+    chunked store scan (or snapshot memmap replay) -> retention-bounded
+    sharded pack. Returns ``(users_enc, items_enc, als_data)``; the caller
+    assembles its own template-specific data carrier around the
+    vocabularies. ``runtime_conf`` (the RuntimeContext's) carries the
+    ``pio.snapshot_mode``/``pio.snapshot_dir`` opt-in.
+    """
+    from predictionio_tpu.parallel.als import ALSConfig
+    from predictionio_tpu.parallel.reader import build_als_data_sharded
+
+    config = ALSConfig(
+        max_len=preparator_params.get_or("maxEventsPerUser", None),
+        buckets=preparator_params.get_or("buckets", 1),
+    )
+    source, users_enc, items_enc = streaming_coo_source(
+        handle, runtime_conf=runtime_conf, event_values=event_values
     )
     als_data = build_als_data_sharded(
         source, None, None, config, mesh,

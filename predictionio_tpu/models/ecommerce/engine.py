@@ -207,7 +207,8 @@ class ECommercePreparator(Preparator):
             n: 1.0 for n in src.event_names
         }
         users_enc, items_enc, als_data = build_streaming_als(
-            src, self.params, ctx.mesh, event_values=event_values
+            src, self.params, ctx.mesh, event_values=event_values,
+            runtime_conf=ctx.runtime_conf,
         )
         categories = _load_categories(src.app_name, src.channel_name)
         data = ECommerceData(

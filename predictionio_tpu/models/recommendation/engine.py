@@ -184,7 +184,7 @@ class RecommendationPreparator(Preparator):
         from predictionio_tpu.models._streaming import build_streaming_als
 
         users_enc, items_enc, als_data = build_streaming_als(
-            src, self.params, ctx.mesh
+            src, self.params, ctx.mesh, runtime_conf=ctx.runtime_conf
         )
         # vocabularies materialized by the scan; edge arrays stay empty --
         # the whole point of the streaming path

@@ -152,21 +152,16 @@ class CooccurrenceAlgorithm(TPUAlgorithm):
         mesh = self.mesh_or_none(ctx)  # user rows dp-sharded, psum acc
         streamed = isinstance(data, StreamingHandle)
         if streamed:
-            from predictionio_tpu.data import storage
+            from predictionio_tpu.models._streaming import streaming_coo_source
             from predictionio_tpu.parallel.mesh import local_mesh
             from predictionio_tpu.parallel.reader import (
                 build_cooc_csr_sharded,
                 distinct_user_counts_sharded,
-                store_coo_chunks,
             )
 
             mesh = mesh or local_mesh(1, 1)
-            source, users_enc, items_enc = store_coo_chunks(
-                storage.get_l_events(),
-                data.app_id,
-                channel_id=data.channel_id,
-                event_names=data.event_names,
-                chunk_rows=data.chunk_rows,
+            source, users_enc, items_enc = streaming_coo_source(
+                data, runtime_conf=getattr(ctx, "runtime_conf", None)
             )
             csr = build_cooc_csr_sharded(
                 source, None, None, mesh,

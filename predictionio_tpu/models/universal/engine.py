@@ -265,25 +265,27 @@ class URAlgorithm(TPUAlgorithm):
         encoders); indicators come out bit-identical to the materialized
         path. Costs 1 + 2 * len(event_names) scans -- bounded memory is
         the trade."""
-        from predictionio_tpu.data import storage
         from predictionio_tpu.data.store import PEventStore
+        from predictionio_tpu.models._streaming import (
+            streaming_multi_event_sources,
+        )
         from predictionio_tpu.parallel.mesh import local_mesh
         from predictionio_tpu.parallel.reader import (
             build_cooc_csr_sharded,
             distinct_user_counts_sharded,
-            store_multi_event_chunks,
             universe_pass,
         )
 
         mesh = mesh or local_mesh(1, 1)
-        sources, users_enc, items_enc = store_multi_event_chunks(
-            storage.get_l_events(),
-            src.app_id,
-            src.event_names,
-            channel_id=src.channel_id,
-            chunk_rows=src.chunk_rows,
+        sources, users_enc, items_enc, universe_ready = (
+            streaming_multi_event_sources(
+                src, runtime_conf=getattr(ctx, "runtime_conf", None)
+            )
         )
-        universe_pass(sources)  # fix the shared universe before any build
+        if not universe_ready:
+            # fix the shared universe before any build (snapshot replay
+            # comes back with the encoders already complete)
+            universe_pass(sources)
         n_users, n_items = len(users_enc.ids), len(items_enc.ids)
 
         primary = src.event_names[0]

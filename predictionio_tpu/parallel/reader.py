@@ -80,6 +80,7 @@ def store_coo_chunks(
     chunk_rows: int = 262_144,
     default_value: float = 1.0,
     event_values: dict[str, float] | None = None,
+    until_time: _dt.datetime | None = None,
 ) -> tuple[ChunkSource, IncrementalEncoder, IncrementalEncoder]:
     """COO chunk source over a backend's columnar chunked scan.
 
@@ -92,6 +93,13 @@ def store_coo_chunks(
     Requires the backend to expose ``iter_interaction_chunks`` (the SQL
     family does); others can stream through any adapter that yields the
     same five columns.
+
+    ``until_time`` bounds every pass to an identical event prefix. The
+    event server accepts writes DURING ``pio train``, so without a bound
+    pass 2 can see entities pass 1 never counted (an ``IndexError`` deep
+    in the slot map), and in multi-host, processes scanning at different
+    wall times would derive divergent layouts. Callers capture it once
+    when the training handle is created and thread it through.
     """
     users_enc, items_enc = IncrementalEncoder(), IncrementalEncoder()
 
@@ -102,6 +110,7 @@ def store_coo_chunks(
             event_names=event_names,
             rating_key=rating_key,
             chunk_rows=chunk_rows,
+            until_time=until_time,
         ):
             keep = [i for i, t in enumerate(tgts) if t is not None]
             uu = users_enc.encode([ents[i] for i in keep])
@@ -135,6 +144,7 @@ def store_multi_event_chunks(
     rating_key: str = "rating",
     chunk_rows: int = 262_144,
     default_value: float = 1.0,
+    until_time: _dt.datetime | None = None,
 ) -> tuple[dict[str, ChunkSource], IncrementalEncoder, IncrementalEncoder]:
     """Per-event-type COO chunk sources over ONE shared entity universe.
 
@@ -144,7 +154,9 @@ def store_multi_event_chunks(
     the shared encoders (so ids are identical no matter which type's
     source runs first, or how often), emitting only its own type's rows.
     A per-type two-pass build therefore costs 2 * len(event_names) scans
-    -- streaming-bounded memory is the trade.
+    -- streaming-bounded memory is the trade. ``until_time`` bounds every
+    scan to one identical prefix (see ``store_coo_chunks``): with
+    2 * len(event_names) passes the mid-train-write window is widest here.
     """
     users_enc, items_enc = IncrementalEncoder(), IncrementalEncoder()
 
@@ -157,6 +169,7 @@ def store_multi_event_chunks(
                     event_names=event_names,
                     rating_key=rating_key,
                     chunk_rows=chunk_rows,
+                    until_time=until_time,
                 )
             ):
                 keep = [k for k, t in enumerate(tgts) if t is not None]
@@ -183,6 +196,132 @@ def store_multi_event_chunks(
                     np.full(int(sel.sum()), default_value, np.float32),
                     tt,
                 )
+
+        return source
+
+    return {n: source_for(n) for n in event_names}, users_enc, items_enc
+
+
+def _kept_user_remap(snapshot) -> tuple[np.ndarray, list[str]]:
+    """Remap snapshot user codes to the ids the LIVE scan would assign.
+
+    The snapshot encodes users by first appearance over ALL rows (the
+    ``EventDataset`` contract); the COO readers encode by first appearance
+    over rows WITH a target entity only. A user appearing first in a
+    targetless row would get a different id, so replay re-derives the
+    kept-rows-only first-appearance order vectorially and the streamed
+    and snapshot-served builds stay bit-identical.
+    Returns ``(remap, kept_vocab)`` with ``remap[old_code] -> new id``
+    (-1 for users never kept).
+    """
+    kept_users = np.asarray(snapshot.column("users"))[
+        np.asarray(snapshot.column("items")) >= 0
+    ]
+    uniq, first_idx = np.unique(kept_users, return_index=True)
+    old_in_order = uniq[np.argsort(first_idx, kind="stable")]
+    full_vocab = snapshot.vocab("users")
+    remap = np.full(len(full_vocab), -1, dtype=np.int64)
+    remap[old_in_order] = np.arange(old_in_order.size)
+    return remap, [full_vocab[int(o)] for o in old_in_order]
+
+
+def _prefilled(vocab: list[str]) -> IncrementalEncoder:
+    enc = IncrementalEncoder()
+    enc.vocab = {v: j for j, v in enumerate(vocab)}
+    return enc
+
+
+def snapshot_coo_chunks(
+    snapshot,
+    chunk_rows: int = 262_144,
+    default_value: float = 1.0,
+    event_values: dict[str, float] | None = None,
+) -> tuple[ChunkSource, IncrementalEncoder, IncrementalEncoder]:
+    """``store_coo_chunks``, served from a columnar snapshot's memmaps.
+
+    Same contract, zero SQL: every pass replays the spilled column files
+    with vectorized decode (value mapping via array lookup instead of a
+    per-row python loop), and the returned encoders come back PRE-FILLED
+    with the exact vocabularies the live scan would have produced --
+    chunks, ids, values, and times are bit-identical to the streamed
+    build over the same bounded prefix.
+    """
+    import time as _time
+
+    from predictionio_tpu.data.snapshot import record_replay_seconds
+
+    remap, kept_users = _kept_user_remap(snapshot)
+    users_enc = _prefilled(kept_users)
+    items_enc = _prefilled(snapshot.vocab("items"))
+    if event_values is not None:
+        name_vals = np.fromiter(
+            (
+                event_values.get(nm, default_value)
+                for nm in snapshot.vocab("names")
+            ),
+            dtype=np.float32,
+            count=len(snapshot.vocab("names")),
+        )
+
+    def source() -> Iterator[Chunk]:
+        t0 = _time.perf_counter()
+        for uu_raw, ii_raw, nn_raw, tt_raw, rr_raw in snapshot.chunks(chunk_rows):
+            sel = ii_raw >= 0
+            uu = remap[uu_raw[sel]]
+            ii = ii_raw[sel]
+            if event_values is not None:
+                vals = name_vals[nn_raw[sel]]
+            else:
+                rr = rr_raw[sel]
+                vals = np.where(np.isnan(rr), default_value, rr).astype(
+                    np.float32
+                )
+            yield uu, ii, vals, tt_raw[sel]
+        record_replay_seconds(_time.perf_counter() - t0)
+
+    return source, users_enc, items_enc
+
+
+def snapshot_multi_event_chunks(
+    snapshot,
+    event_names: list[str],
+    chunk_rows: int = 262_144,
+    default_value: float = 1.0,
+) -> tuple[dict[str, ChunkSource], IncrementalEncoder, IncrementalEncoder]:
+    """``store_multi_event_chunks``, served from a snapshot's memmaps.
+
+    The shared entity universe comes back pre-filled (it is fixed by the
+    spilled stream), so the ``universe_pass`` priming scan and all
+    2 * len(event_names) per-type SQL scans collapse into cheap memmap
+    replays.
+    """
+    import time as _time
+
+    from predictionio_tpu.data.snapshot import record_replay_seconds
+
+    remap, kept_users = _kept_user_remap(snapshot)
+    users_enc = _prefilled(kept_users)
+    items_enc = _prefilled(snapshot.vocab("items"))
+    code_of = {nm: c for c, nm in enumerate(snapshot.vocab("names"))}
+
+    def source_for(wanted: str) -> ChunkSource:
+        code = code_of.get(wanted, -1)
+
+        def source() -> Iterator[Chunk]:
+            t0 = _time.perf_counter()
+            for uu_raw, ii_raw, nn_raw, tt_raw, _rr in snapshot.chunks(
+                chunk_rows
+            ):
+                sel = (ii_raw >= 0) & (nn_raw == code)
+                if not sel.any():
+                    continue
+                yield (
+                    remap[uu_raw[sel]],
+                    ii_raw[sel],
+                    np.full(int(sel.sum()), default_value, np.float32),
+                    tt_raw[sel],
+                )
+            record_replay_seconds(_time.perf_counter() - t0)
 
         return source
 

@@ -28,6 +28,19 @@ def register(sub: argparse._SubParsersAction) -> None:
         help="continue the variant's latest crashed/preempted run from its"
         " step checkpoints instead of starting over",
     )
+    train.add_argument(
+        "--snapshot-mode",
+        choices=("off", "use", "refresh"),
+        default=None,
+        help="training-snapshot cache: 'use' replays the on-disk columnar"
+        " spill (building it on first run), 'refresh' first appends events"
+        " ingested since; default off (always scan the event store)",
+    )
+    train.add_argument(
+        "--snapshot-dir",
+        default=None,
+        help="snapshot root (default $PIO_FS_BASEDIR/snapshots)",
+    )
     train.add_argument("passthrough", nargs="*", help="runtime conf after --")
     train.set_defaults(func=cmd_train)
 
@@ -104,6 +117,14 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     variant = _load_variant(args)
     variant.runtime_conf.update(_parse_passthrough(args.passthrough))
+    # runtime conf reaches components holding a ctx; the env mirrors it for
+    # ctx-free layers (PEventStore.dataset) in this same process
+    if args.snapshot_mode:
+        variant.runtime_conf["pio.snapshot_mode"] = args.snapshot_mode
+        os.environ["PIO_SNAPSHOT_MODE"] = args.snapshot_mode
+    if args.snapshot_dir:
+        variant.runtime_conf["pio.snapshot_dir"] = args.snapshot_dir
+        os.environ["PIO_SNAPSHOT_DIR"] = args.snapshot_dir
     params = WorkflowParams(
         batch=args.batch,
         skip_sanity_check=args.skip_sanity_check,

@@ -145,7 +145,11 @@ def instrumented_router(before_scrape=None) -> tuple[Router, "object"]:
     service mirror externally-tracked state (e.g. the query server's
     served-count) into the registry without maintaining it in two places.
     """
-    from predictionio_tpu.utils.metrics import CONTENT_TYPE, MetricsRegistry
+    from predictionio_tpu.utils.metrics import (
+        CONTENT_TYPE,
+        MetricsRegistry,
+        global_registry,
+    )
 
     registry = MetricsRegistry()
     router = Router(metrics=registry)
@@ -153,7 +157,13 @@ def instrumented_router(before_scrape=None) -> tuple[Router, "object"]:
     def handle_metrics(request: Request) -> Response:
         if before_scrape is not None:
             before_scrape(registry)
-        return Response(200, registry.exposition(), content_type=CONTENT_TYPE)
+        body = registry.exposition()
+        # process-global series (training-snapshot cache etc.) ride every
+        # service's scrape; names are disjoint from per-service ones
+        shared = global_registry().exposition().strip()
+        if shared:
+            body = body.rstrip("\n") + "\n" + shared + "\n"
+        return Response(200, body, content_type=CONTENT_TYPE)
 
     router.add("GET", "/metrics", handle_metrics)
     return router, registry

@@ -25,6 +25,15 @@ DEFAULT_BUCKETS = (
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+def global_registry() -> "MetricsRegistry":
+    """The process-wide registry for instrumentation that does not belong
+    to any one service router (e.g. the training-snapshot cache, which
+    runs inside ``pio train`` AND inside servers that train in-process).
+    ``instrumented_router`` merges it into every ``/metrics`` scrape; the
+    names recorded here must not collide with per-service ones."""
+    return _GLOBAL_REGISTRY
+
+
 def _escape(value: str) -> str:
     return str(value).replace("\\", "\\\\").replace('"', '\\"')
 
@@ -155,3 +164,6 @@ class MetricsRegistry:
                     lines.append(f"{name}_sum{_fmt_labels(labels)} {row[-2]:.17g}")
                     lines.append(f"{name}_count{_fmt_labels(labels)} {row[-1]}")
         return "\n".join(lines) + "\n"
+
+
+_GLOBAL_REGISTRY = MetricsRegistry()
