@@ -180,6 +180,23 @@ def als_flops_per_iteration(data, rank: int) -> float:
     )
 
 
+def als_bytes_per_iteration(data, rank: int, itemsize: int, fused: bool) -> float:
+    """HBM bytes one full ALS iteration moves through its half-step tails
+    (``ops.als_gram.half_step_bytes``): the half-step is gather/bandwidth-
+    bound, so achieved GB/s against this model -- NOT the MFU number, which
+    an einsum-heavy but bandwidth-starved kernel can keep misleadingly low
+    -- is the efficiency axis that matters. ``fused`` = the Pallas kernel
+    (no [rows, L, K] HBM intermediate); unfused = the XLA einsum path
+    (write + 2 read passes over it)."""
+    from predictionio_tpu.ops.als_gram import half_step_bytes
+
+    return sum(
+        half_step_bytes(*block.indices.shape, rank, itemsize, fused)
+        for side in (data.by_row, data.by_col)
+        for block in side.blocks
+    )
+
+
 def full_scale_flops_estimate(scale: float) -> float:
     """Analytic FLOPs/iteration at ``scale`` reduction of ML-20M.
 
@@ -400,6 +417,64 @@ def secondary_main(result_path: str) -> None:
             "config": "#8 train_data_eps (120k events, sqlite, 2-pass read)",
         }
 
+    def als_half_step_gbps():
+        """#9: achieved HBM GB/s of the ALS half-step tail, fused Pallas
+        kernel vs unfused XLA einsum path, against the bytes-moved model
+        (``ops.als_gram.half_step_bytes``). On TPU both paths are timed at
+        a reduced ml20m shape (same generator as the primary metric); the
+        CPU child reports the einsum path's GB/s plus the model's byte
+        ratio only -- the interpret-mode kernel is a correctness vehicle,
+        and timing it would benchmark the Pallas interpreter, not the
+        half-step."""
+        import dataclasses
+
+        from predictionio_tpu.parallel.als import ALSConfig, build_als_data
+
+        scale = 4.0 if tpu else 400.0
+        n_users = int(N_USERS_FULL / scale ** 0.5)
+        n_items = int(N_ITEMS_FULL / scale ** 0.5)
+        n_edges = int(N_EDGES_FULL / scale)
+        users, items, ratings = make_dataset(n_edges, n_users, n_items)
+        config = ALSConfig(
+            rank=RANK, reg=0.05, max_len=256,
+            dtype="bfloat16" if tpu else "float32",
+            buckets=4 if tpu else 1,
+        )
+        data = build_als_data(users, items, ratings, n_users, n_items, config)
+        itemsize = 2 if tpu else 4
+        fused_b = als_bytes_per_iteration(data, RANK, itemsize, fused=True)
+        unfused_b = als_bytes_per_iteration(data, RANK, itemsize, fused=False)
+        res = {
+            "edges": n_edges,
+            "bytes_per_iter_fused": fused_b,
+            "bytes_per_iter_unfused": unfused_b,
+            "model_bytes_ratio": round(unfused_b / fused_b, 2),
+            "config": "#9 als_half_step_gbps (bytes model: ops.als_gram)",
+        }
+        if not tpu:
+            sec = run_als(
+                "cpu", data, dataclasses.replace(config, solver="xla"), 2
+            )
+            res["sec_per_iter_xla"] = round(sec, 5)
+            res["gbps_xla"] = round(unfused_b / sec / 1e9, 2)
+            res["fused"] = (
+                "skipped on CPU (interpret-mode kernel times the "
+                "interpreter, not the half-step)"
+            )
+            return res
+        for solver in ("xla", "pallas"):
+            sec = run_als(
+                platform, data,
+                dataclasses.replace(config, solver=solver), 10,
+            )
+            bytes_iter = fused_b if solver == "pallas" else unfused_b
+            res[f"sec_per_iter_{solver}"] = round(sec, 5)
+            res[f"gbps_{solver}"] = round(bytes_iter / sec / 1e9, 2)
+        res["fused_speedup"] = round(
+            res["sec_per_iter_xla"] / res["sec_per_iter_pallas"], 3
+        )
+        return res
+
     phase("naive_bayes_fit", nb_fit)
     phase("logreg_lbfgs_fit", logreg_fit)
     phase("cooccurrence_llr_indicators", cooc_indicators)
@@ -407,6 +482,7 @@ def secondary_main(result_path: str) -> None:
     phase("serving_qps", serving_qps)
     phase("ingest_eps", ingest_eps)
     phase("train_data_eps", train_data_eps)
+    phase("als_half_step_gbps", als_half_step_gbps)
 
 
 def child_main(mode: str, result_path: str) -> None:
@@ -454,6 +530,10 @@ def child_main(mode: str, result_path: str) -> None:
         dtype="bfloat16" if mode == "tpu" else "float32",
         buckets=int(os.environ.get("PIO_BENCH_BUCKETS", "4"))
         if mode == "tpu" else 1,
+        # per-platform default: fused Pallas gather->Gram half-step on the
+        # TPU, XLA einsums on the CPU baseline; PIO_BENCH_ALS_SOLVER pins
+        # either path for A/B runs
+        solver=os.environ.get("PIO_BENCH_ALS_SOLVER", "auto"),
     )
     data = build_als_data(users, items, ratings, n_users, n_items, config)
 
@@ -468,12 +548,20 @@ def child_main(mode: str, result_path: str) -> None:
     # scalar-fetch sync (tunnel RTT) amortizes out; CPU iterations are
     # seconds each and 2 suffice
     sec = run_als(platform, data, config, 20 if mode == "tpu" else 2)
+    from predictionio_tpu.parallel.als import resolve_solver
+
+    solver_used = resolve_solver(config.solver, platform)
+    itemsize = 2 if config.dtype == "bfloat16" else 4
     out = {
         "mode": mode,
         "scale": scale,
         "edges": n_edges,
         "sec_per_iter": sec,
         "flops_per_iter": als_flops_per_iteration(data, config.rank),
+        "solver": solver_used,
+        "bytes_per_iter": als_bytes_per_iteration(
+            data, config.rank, itemsize, fused=solver_used == "pallas"
+        ),
         "run_record": EVIDENCE["runs"].get(platform),
         "elapsed_s": round(time.time() - t0, 1),
     }
@@ -742,15 +830,31 @@ def _run_phases(bench: _Bench) -> None:
             flops = full["flops_per_iter"]
             achieved = flops / tpu_sec
             # v5e-1 peak: ~197 TFLOP/s bf16 (f32 accumulation); the solver
-            # runs f32 Grams, so this MFU is a conservative lower bound
+            # runs f32 Grams, so this MFU is a conservative lower bound.
+            # The half-step is BANDWIDTH-bound, so the achieved HBM GB/s
+            # against the bytes-moved model (als_bytes_per_iteration) is
+            # reported alongside -- low MFU with high GB/s is the expected
+            # healthy profile, not a problem
             EVIDENCE["mfu"] = {
                 "flops_per_iteration": flops,
                 "achieved_flops_per_s": achieved,
                 "peak_bf16_flops_per_s": 197e12,
                 "mfu_vs_bf16_peak": round(achieved / 197e12, 4),
             }
+            if full.get("bytes_per_iter"):
+                EVIDENCE["mfu"]["als_solver"] = full.get("solver")
+                EVIDENCE["mfu"]["hbm_bytes_per_iteration"] = full["bytes_per_iter"]
+                EVIDENCE["mfu"]["achieved_hbm_gbps"] = round(
+                    full["bytes_per_iter"] / tpu_sec / 1e9, 2
+                )
             vs = (cpu_full_sec_est / tpu_sec) if cpu_full_sec_est else 0.0
             bench.edges = full["edges"]
+            gbps_tail = (
+                f"; hbm ~{EVIDENCE['mfu']['achieved_hbm_gbps']:.0f} GB/s"
+                f" ({full.get('solver')} half-step)"
+                if "achieved_hbm_gbps" in EVIDENCE["mfu"]
+                else ""
+            )
             bench.result = {
                 "value": round(1.0 / tpu_sec, 4),
                 "vs_baseline": round(vs, 3),
@@ -758,9 +862,11 @@ def _run_phases(bench: _Bench) -> None:
                     f"tpu({tpu_platform}) vs host-cpu baseline"
                     f" {1.0 / cpu_full_sec_est:.3f} it/s (cpu scaled-estimate);"
                     f" mfu~{EVIDENCE['mfu']['mfu_vs_bf16_peak']:.1%} of bf16 peak"
+                    f"{gbps_tail}"
                     if cpu_full_sec_est
                     else f"tpu({tpu_platform}); no cpu baseline this run;"
                     f" mfu~{EVIDENCE['mfu']['mfu_vs_bf16_peak']:.1%} of bf16 peak"
+                    f"{gbps_tail}"
                 ),
             }
             _append_history(

@@ -82,6 +82,24 @@ def warn_misplaced_packing_params(algo_params, template: str) -> None:
         )
 
 
+def resolve_solver_override(config: ALSConfig, ctx) -> ALSConfig:
+    """Apply the run-scoped ``pio.als_solver`` conf (``pio train
+    --als-solver``) over the engine.json ``alsSolver`` param.
+
+    The CLI flag is an operator override -- benchmarking the fused Pallas
+    half-step against the XLA einsum path, or pinning "xla" if a jax/Mosaic
+    upgrade regresses the kernel -- so it wins over the variant file.
+    ``make_iteration`` validates the value.
+    """
+    import dataclasses
+
+    solver = getattr(ctx, "runtime_conf", None) or {}
+    solver = solver.get("pio.als_solver")
+    if not solver:
+        return config
+    return dataclasses.replace(config, solver=str(solver))
+
+
 def resolve_factor_sharding(config: ALSConfig, mesh) -> ALSConfig:
     """Resolve ``factor_sharding="auto"`` against the actual mesh.
 
@@ -219,6 +237,7 @@ def fit_with_checkpoint(
     ``interval`` <= 0 disables checkpointing entirely.
     """
     config = resolve_factor_sharding(config, mesh)
+    config = resolve_solver_override(config, ctx)
     checkpoint = ctx.checkpoint_manager(name) if interval > 0 else None
     init, start_iteration, callback = None, 0, None
     if checkpoint is not None:

@@ -26,6 +26,7 @@ from predictionio_tpu.parallel.mesh import (
     fetch_global,
     put_global,
 )
+from predictionio_tpu.utils.jax_compat import IS_LEGACY_JAX
 
 
 @dataclass
@@ -131,7 +132,11 @@ def train_ncf(
             {"user": data_shard, "item": data_shard, "label": data_shard},
         ),
         out_shardings=(p_shard, None, NamedSharding(mesh, P())),
-        donate_argnums=(0, 1),
+        # donating the tp-sharded adam state miscompiles on legacy (0.4.x)
+        # jax: XLA pairs the donated buffers with wrong-shaped outputs.
+        # Params alone carry the bulk of the memory; the moments re-donate
+        # once the floor moves past the fixed runtime
+        donate_argnums=(0,) if IS_LEGACY_JAX else (0, 1),
     )
 
     np_rng = np.random.default_rng(config.seed)

@@ -1,0 +1,235 @@
+"""Fused Pallas gather->Gram half-step kernels for ALS.
+
+The ALS half-step tail (``parallel/als.py``) is gather- and bandwidth-
+bound: the XLA path materializes the gathered opposite-side factors as a
+``[rows, L, K]`` HBM intermediate (one write + two einsum read passes)
+before reducing it to a ``[K, K]`` Gram and ``[K]`` rhs per row -- the
+ragged-data bottleneck the ALX paper (arxiv 2112.02194, PAPERS.md) names
+as THE TPU engineering problem for matrix factorization. This kernel
+streams padded-CSR row blocks through VMEM and performs the gather with
+double-buffered row DMAs from the HBM-resident factor table, accumulating
+each row's Gram/rhs in f32 on-chip; the ``[rows, L, K]`` intermediate
+never exists in HBM, so the half-step's HBM traffic drops from
+``~3 * rows * L * K * itemsize`` (write + 2 reads) to ONE random-gather
+read pass of ``rows * L * K * itemsize``.
+
+Contract (shared with the XLA path -- ``parallel.als`` padding invariant):
+
+- ``indices[r, l]`` selects a row of ``factors``; padding slots (and, in
+  model-sharded mode, out-of-shard hits) point at a trailing ZERO row, so
+  every padding contribution dies through the gathered zeros -- no mask
+  stream crosses HBM.
+- ``factors`` is ``[S + 1, K]`` (zero row appended), f32 or bf16; Gram and
+  rhs accumulate f32 regardless (the ALX mixed-precision recipe).
+- explicit mode:  gram[r] = sum_l y y^T,          rhs[r] = sum_l v * y
+- implicit mode:  gram[r] = sum_l (alpha v) y y^T, rhs[r] = sum_l (1 + alpha v) y
+  (the YtY global term, the ridge, and the solve stay OUTSIDE the kernel:
+  they are [K, K]-small and shared with the XLA path bit-for-bit).
+
+Layout/VMEM budget (mirrors the hard-won notes in ``ops/flash_attention``):
+
+- Blocks keep their last two dims equal to the array dims (K is far below
+  a lane, so (BR, K, K) / (BR, K) output blocks are exact-dim blocks; the
+  [BR, C, K] gather scratch pads K up to a lane internally).
+- The index block rides SMEM -- DMA source addressing is scalar work; a
+  [BR, L] i32 block is BR*L*4 bytes (8 KB at BR=8, L=256).
+- VMEM per program ~= BR*L*4 (values) + 2*BR*C*K*itemsize (double-buffered
+  gather scratch) + BR*(K*K + K)*4 (accumulator blocks): ~0.3 MB at the
+  bench shape (BR=8, L=256, C=128, K=16, bf16 table) -- far under the
+  ~16 MB/core budget, leaving the auto-pipeliner room to double-buffer
+  the idx/val streams across grid steps.
+- The gather itself is one row-DMA per (row, l) slot: the DMA engine keeps
+  BR*C descriptors in flight per chunk while the MXU folds the PREVIOUS
+  chunk (classic two-slot double buffering over the L dimension). Each
+  descriptor moves only K*itemsize bytes, so the gather runs at the
+  random-row bandwidth the layout admits -- the win over XLA is not a
+  faster gather but the intermediate that never hits HBM.
+- On CPU meshes the kernels run in interpret mode (the
+  ``ops/flash_attention`` precedent), so tier-1 CPU tests exercise this
+  exact kernel code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from predictionio_tpu.utils.jax_compat import shape_struct
+
+#: rows per grid step (a CAP: the largest power of two <= this that divides
+#: the block's rows is used, so a 24-row block split over a 2-device data
+#: axis -- 12 rows per device -- runs at BR=4 instead of failing). 8 keeps
+#: the [BR, C, K] gather scratch small on the aligned common case.
+BLOCK_ROWS = 8
+
+#: gather chunk (columns of the L dimension folded per double-buffer slot);
+#: the largest of these dividing L is used, so L only needs 8-alignment.
+_CHUNKS = (256, 128, 64, 32, 16, 8)
+
+
+def _pick_chunk(pad_len: int) -> int:
+    for cand in _CHUNKS:
+        if cand <= pad_len and pad_len % cand == 0:
+            return cand
+    raise ValueError(
+        f"padded length {pad_len} is not a multiple of 8 (pack_padded_csr "
+        "guarantees len_multiple=8)"
+    )
+
+
+def _gram_rhs_kernel(
+    idx_ref,    # SMEM [BR, L] i32
+    val_ref,    # VMEM [BR, L] f32
+    alpha_ref,  # SMEM [1, 1]  f32 (ignored in explicit mode)
+    table_ref,  # ANY  [S + 1, K] factor dtype (stays in HBM)
+    gram_ref,   # VMEM [BR, K, K] f32 out
+    rhs_ref,    # VMEM [BR, K] f32 out
+    gathered,   # VMEM scratch [2, BR, C, K] factor dtype
+    sem,        # DMA semaphores [2] (one per buffer slot)
+    *,
+    implicit: bool,
+    chunk: int,
+):
+    br, pad_len = idx_ref.shape
+    n_chunks = pad_len // chunk
+    k = table_ref.shape[1]
+
+    def dma(slot: int, ci: int, p):
+        r, cl = p // chunk, p % chunk
+        return pltpu.make_async_copy(
+            table_ref.at[idx_ref[r, ci * chunk + cl]],
+            gathered.at[slot, r, cl],
+            sem.at[slot],
+        )
+
+    def issue(ci: int) -> None:
+        slot = ci % 2
+
+        def start(p, carry):
+            dma(slot, ci, p).start()
+            return carry
+
+        jax.lax.fori_loop(0, br * chunk, start, None)
+
+    def drain(ci: int) -> None:
+        slot = ci % 2
+
+        def wait(p, carry):
+            dma(slot, ci, p).wait()
+            return carry
+
+        jax.lax.fori_loop(0, br * chunk, wait, None)
+
+    issue(0)
+    gram_acc = jnp.zeros((br, k, k), jnp.float32)
+    rhs_acc = jnp.zeros((br, k), jnp.float32)
+    # n_chunks is static: the chunk loop unrolls, keeping the double-buffer
+    # slot index STATIC (Mosaic cannot dynamically index the sublane-major
+    # scratch on the compute side; the DMA .at[] indices may stay dynamic)
+    for ci in range(n_chunks):
+        if ci + 1 < n_chunks:
+            issue(ci + 1)  # next chunk's DMAs fly while this one folds
+        drain(ci)
+        g = gathered[ci % 2].astype(jnp.float32)              # [BR, C, K]
+        v = val_ref[:, ci * chunk : (ci + 1) * chunk]         # [BR, C]
+        if implicit:
+            w = alpha_ref[0, 0] * v
+            gram_w, rhs_w = w, 1.0 + w
+        else:
+            gram_w, rhs_w = None, v
+        lhs = g if gram_w is None else g * gram_w[..., None]
+        gram_acc = gram_acc + jax.lax.dot_general(
+            lhs, g,
+            dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        rhs_acc = rhs_acc + jnp.sum(g * rhs_w[..., None], axis=1)
+    gram_ref[...] = gram_acc
+    rhs_ref[...] = rhs_acc
+
+
+def gram_rhs(
+    indices,
+    values,
+    factors,
+    alpha=0.0,
+    *,
+    implicit: bool = False,
+    interpret: bool = False,
+    block_rows: int = BLOCK_ROWS,
+):
+    """Fused gather->Gram/rhs over one padded-CSR block.
+
+    ``indices`` i32 [R, L] (padding -> the trailing zero factor row),
+    ``values`` f32 [R, L], ``factors`` [S + 1, K] f32/bf16 (zero row
+    appended). Returns ``(gram [R, K, K] f32, rhs [R, K] f32)``; the
+    caller adds ridge/YtY and solves (``ops.linalg.batched_spd_solve``).
+    ``alpha`` may be a traced scalar (implicit mode's confidence scale).
+    """
+    r, pad_len = indices.shape
+    k = factors.shape[1]
+    br = min(block_rows, r)
+    while br > 1 and r % br:
+        br //= 2  # e.g. 12 rows/device under a 2-way data split -> BR=4
+    chunk = _pick_chunk(pad_len)
+    alpha_arr = jnp.asarray(alpha, jnp.float32).reshape(1, 1)
+    kernel = functools.partial(
+        _gram_rhs_kernel, implicit=implicit, chunk=chunk
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(r // br,),
+        in_specs=[
+            pl.BlockSpec((br, pad_len), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((br, pad_len), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, k, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((br, k), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            shape_struct((r, k, k), jnp.float32, indices),
+            shape_struct((r, k), jnp.float32, indices),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, br, chunk, k), factors.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(indices, jnp.int32), values, alpha_arr, factors)
+
+
+def half_step_bytes(
+    rows: int, pad_len: int, rank: int, itemsize: int, fused: bool
+) -> float:
+    """HBM bytes one half-step tail moves over a [rows, pad_len] block.
+
+    The bytes-moved model behind the ``als_half_step_gbps`` bench metric
+    (the half-step is bandwidth-bound, so GB/s -- not the misleading MFU
+    number -- is the efficiency axis):
+
+    shared streams: indices (i32) + values (f32) read once; Gram + rhs
+    (f32) written once. The factor-table source reads are counted as the
+    gather's random-read pass (rows*L*K*itemsize in expectation); the
+    table's cold first touch is shared by both paths and not modeled
+    per block.
+
+    - fused: the gather's random read is the ONLY [rows, L, K]-sized pass;
+      the result accumulates in VMEM.
+    - unfused (XLA): the same random read, PLUS the gathered [rows, L, K]
+      intermediate written to HBM once and read back by the Gram and rhs
+      einsums (2 passes) -> 4 gather-sized passes in total.
+    """
+    streams = rows * pad_len * (4 + 4)            # indices + values
+    outs = rows * (rank * rank + rank) * 4        # gram + rhs, f32
+    gather_pass = rows * pad_len * rank * itemsize
+    passes = 1 if fused else 4
+    return float(streams + outs + passes * gather_pass)

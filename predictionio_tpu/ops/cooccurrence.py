@@ -25,6 +25,7 @@ import numpy as np
 
 from predictionio_tpu.ops.ragged import PaddedCSR
 from predictionio_tpu.parallel.mesh import cached_by_mesh
+from predictionio_tpu.utils.jax_compat import pcast_varying, shard_map
 
 
 def _dense_onehot(indices, mask, num_cols: int):
@@ -125,8 +126,8 @@ def _build_cooc_fn(
 
         # fresh constants are "unvarying" under shard_map's vma tracking;
         # the scan carry must match the (varying) body output type
-        acc0 = jax.lax.pcast(
-            jnp.zeros((num_p, num_o), dtype=jnp.float32), "data", to="varying"
+        acc0 = pcast_varying(
+            jnp.zeros((num_p, num_o), dtype=jnp.float32), "data"
         )
         acc, _ = jax.lax.scan(
             body, acc0, (split(idx_p), split(msk_p), split(idx_o), split(msk_o))
@@ -143,7 +144,7 @@ def _build_cooc_fn(
     row = PartitionSpec("data")
     rep = PartitionSpec()
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(row, row, row, row, rep, rep),

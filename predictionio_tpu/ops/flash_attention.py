@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from predictionio_tpu.utils.jax_compat import shape_struct
+
 _NEG = -1e30  # matches plain_attention's finite masked-score constant
 
 BLOCK_Q = 128
@@ -268,11 +270,9 @@ def _flash_forward(q, k, v, mask, causal, sm_scale, interpret):
 def _struct(shape, dtype, like):
     """ShapeDtypeStruct that inherits `like`'s varying-mesh-axes (vma) so
     the kernel composes under shard_map(check_vma=True); plain (non-sharded)
-    callers get the ordinary struct."""
-    vma = getattr(jax.typeof(like), "vma", None)
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
+    callers -- and pre-vma jax (utils.jax_compat) -- get the ordinary
+    struct."""
+    return shape_struct(shape, dtype, like)
 
 
 def _flash_fwd(q, k, v, mask, causal, sm_scale, interpret):

@@ -40,9 +40,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from predictionio_tpu.ops.als_gram import gram_rhs
 from predictionio_tpu.ops.linalg import batched_spd_solve
 from predictionio_tpu.ops.ragged import PaddedCSR, pack_padded_csr, round_up
 from predictionio_tpu.parallel.mesh import cached_by_mesh
+from predictionio_tpu.utils.jax_compat import axis_size, shard_map
 
 
 @dataclass
@@ -64,6 +66,14 @@ class ALSConfig:
     #: memory drops to total_slots/model_axis rows (see docs/parallelism.md
     #: for the max-catalog math). Requires build_als_data(model_shards=m).
     factor_sharding: str = "replicated"
+    #: half-step tail implementation, chosen per TARGET platform like the
+    #: unrolled-vs-LAPACK ``batched_spd_solve`` split: "pallas" runs the
+    #: fused gather->Gram kernel (``ops.als_gram``) that never writes the
+    #: [rows, L, K] gathered intermediate to HBM; "xla" is the einsum path.
+    #: "auto" = pallas on accelerators, xla on CPU meshes (where the fused
+    #: kernel runs in interpret mode -- a correctness vehicle, not a fast
+    #: path). Tiny ranks on CPU stay fastest on "xla".
+    solver: str = "auto"
 
 
 @dataclass
@@ -349,6 +359,26 @@ def _factor_precision(dtype):
     return "highest" if dtype == jnp.float32 else None
 
 
+def _finish_explicit(gram, rhs, n_obs, reg, rank, unroll, out_dtype):
+    """ALS-WR ridge + batched solve over precomputed Gram/rhs -- the tail
+    both the XLA einsum path and the fused Pallas kernel share bit-for-bit
+    (so solver parity reduces to Gram/rhs parity)."""
+    # MLlib-style weighted regularization: lambda * n_obs (ALS-WR); constant
+    # lambda would also be defensible -- n_obs matches the reference template
+    ridge = reg * jnp.maximum(n_obs, 1.0)
+    gram = gram + ridge[:, None, None] * jnp.eye(rank, dtype=gram.dtype)
+    return batched_spd_solve(gram, rhs, unroll=unroll).astype(out_dtype)
+
+
+def _finish_implicit(gram_fix, rhs, yty, reg, rank, unroll, out_dtype):
+    """YtY + correction + constant ridge + solve (shared tail, see above).
+
+    ``gram_fix`` holds only the per-row observed-entry corrections
+    sum_obs (c-1) y y^T; the replicated global Gram lands here."""
+    gram = yty[None] + gram_fix + reg * jnp.eye(rank, dtype=yty.dtype)
+    return batched_spd_solve(gram, rhs, unroll=unroll).astype(out_dtype)
+
+
 def _gram_solve_explicit(gathered, values, n_obs, reg, rank, unroll, out_dtype):
     """Gram + ALS-WR ridge + rhs + batched solve over pre-gathered factors.
 
@@ -374,15 +404,11 @@ def _gram_solve_explicit(gathered, values, n_obs, reg, rank, unroll, out_dtype):
         precision=_factor_precision(gathered.dtype),
         preferred_element_type=jnp.float32,
     )
-    # MLlib-style weighted regularization: lambda * n_obs (ALS-WR); constant
-    # lambda would also be defensible -- n_obs matches the reference template
-    ridge = reg * jnp.maximum(n_obs, 1.0)
-    gram = gram + ridge[:, None, None] * jnp.eye(rank, dtype=gram.dtype)
     rhs = jnp.einsum(
         "rlk,rl->rk", gathered, values,
         precision="highest", preferred_element_type=jnp.float32,
     )
-    return batched_spd_solve(gram, rhs, unroll=unroll).astype(out_dtype)
+    return _finish_explicit(gram, rhs, n_obs, reg, rank, unroll, out_dtype)
 
 
 def _gram_solve_implicit(gathered, values, yty, reg, alpha, rank, unroll, out_dtype):
@@ -400,12 +426,11 @@ def _gram_solve_implicit(gathered, values, yty, reg, alpha, rank, unroll, out_dt
         "rlk,rl,rlj->rkj", gathered, conf_minus_1, gathered,
         precision="highest", preferred_element_type=jnp.float32,
     )
-    gram = yty[None] + gram_fix + reg * jnp.eye(rank, dtype=yty.dtype)
     rhs = jnp.einsum(
         "rlk,rl->rk", gathered, (1.0 + conf_minus_1),
         precision="highest", preferred_element_type=jnp.float32,
     )
-    return batched_spd_solve(gram, rhs, unroll=unroll).astype(out_dtype)
+    return _finish_implicit(gram_fix, rhs, yty, reg, rank, unroll, out_dtype)
 
 
 def _factors_yty(factors):
@@ -425,30 +450,53 @@ def _half_step_explicit(indices, values, n_obs, factors, reg, rank, unroll):
     )
 
 
-def _half_step_implicit(indices, values, n_obs, factors, reg, alpha, rank, unroll):
+def _half_step_implicit(indices, values, n_obs, factors, yty, reg, alpha,
+                        rank, unroll):
     """Replicated-factor implicit half-step.
 
     ``n_obs`` is unused (constant lambda) but kept so both modes share one
-    block layout. Inter-bucket padding rows of ``factors`` are zero, so
-    they add nothing to the global Gram; the appended trailing zero row is
-    dropped from it explicitly.
+    block layout. ``yty`` is the side's global factor Gram, computed ONCE
+    per half-step by the caller (it is bucket-invariant; computing it here
+    would redo the [S, K] reduction for every bucket).
     """
     del n_obs
-    yty = _factors_yty(factors[:-1])
     gathered = factors[indices]
     return _gram_solve_implicit(
         gathered, values, yty, reg, alpha, rank, unroll, factors.dtype
     )
 
 
-def _sharded_block_body(idx, values, n_obs, opp_local, reg, alpha,
-                        implicit, rank, unroll):
+def _half_step_pallas(idx, values, n_obs, factors, yty, reg, alpha,
+                      implicit, rank, unroll, interpret):
+    """Replicated-factor half-step through the fused gather->Gram kernel.
+
+    Runs inside shard_map over the mesh (a pallas_call is opaque to GSPMD,
+    so the data-axis row split is explicit here): each device streams its
+    CSR row shard through ``ops.als_gram.gram_rhs`` against the replicated
+    factor table and solves its rows locally -- no collectives; the
+    [rows, L, K] gathered intermediate never exists in HBM.
+    """
+    gram, rhs = gram_rhs(
+        idx, values, factors, alpha, implicit=implicit, interpret=interpret
+    )
+    if implicit:
+        return _finish_implicit(
+            gram, rhs, yty, reg, rank, unroll, factors.dtype
+        )
+    return _finish_explicit(gram, rhs, n_obs, reg, rank, unroll, factors.dtype)
+
+
+def _sharded_block_body(idx, values, n_obs, opp_local, yty, reg, alpha,
+                        implicit, rank, unroll, solver="xla",
+                        interpret=False):
     """Per-device half-step for one bucket with MODEL-SHARDED factors.
 
     Runs inside shard_map over the full ("data", "model") mesh. Each
     device holds opp_local = its model-axis shard of the opposite factor
     matrix ([S/m, K], replicated across the data axis) and the full local
-    data-shard of the bucket's CSR rows. The ALX block exchange:
+    data-shard of the bucket's CSR rows. ``yty`` (implicit mode) arrives
+    replicated from the caller -- it is bucket-invariant and was formerly
+    re-psum'd here per bucket. The ALX block exchange:
 
     1. gather local hits only (out-of-shard indices -- including the
        padding sentinel, which is out of EVERY shard -- contribute zeros);
@@ -458,21 +506,49 @@ def _sharded_block_body(idx, values, n_obs, opp_local, reg, alpha,
     3. each device solves its rows' normal equations -- compute scales
        with the full d*m device count, not just d.
 
+    solver="pallas" replaces steps 1-2's [rows, L, K] exchange with the
+    fused kernel: out-of-shard indices remap to a LOCAL trailing zero row
+    (the same padding invariant, applied to the shard), each device
+    accumulates its partial [rows, K, K]/[rows, K] Gram/rhs on-chip, and
+    the psum_scatter runs over those -- (K^2 + K)/(L * K) of the XLA
+    path's ICI traffic (~15x less at L=256, K=16) and no HBM gathered
+    intermediate.
+
     Output rows per device: the model-axis slice of the local data shard,
     i.e. global layout P(("data", "model")).
     """
-    m = jax.lax.axis_size("model")
+    m = axis_size("model")
     mi = jax.lax.axis_index("model")
     s_m = opp_local.shape[0]
     loc = idx - mi * s_m
+    rows = idx.shape[0] // m
+    if solver == "pallas":
+        hit = (loc >= 0) & (loc < s_m)
+        safe = jnp.where(hit, loc, s_m).astype(jnp.int32)
+        gram, rhs = gram_rhs(
+            safe, values, _append_zero_row(opp_local), alpha,
+            implicit=implicit, interpret=interpret,
+        )
+        gram = jax.lax.psum_scatter(
+            gram, "model", scatter_dimension=0, tiled=True
+        )
+        rhs = jax.lax.psum_scatter(
+            rhs, "model", scatter_dimension=0, tiled=True
+        )
+        if implicit:
+            return _finish_implicit(
+                gram, rhs, yty, reg, rank, unroll, opp_local.dtype
+            )
+        n_s = jax.lax.dynamic_slice_in_dim(n_obs, mi * rows, rows, 0)
+        return _finish_explicit(
+            gram, rhs, n_s, reg, rank, unroll, opp_local.dtype
+        )
     hit = (loc >= 0) & (loc < s_m)
     g = opp_local[jnp.clip(loc, 0, s_m - 1)]
     g = g * hit[..., None].astype(g.dtype)
     g = jax.lax.psum_scatter(g, "model", scatter_dimension=0, tiled=True)
-    rows = idx.shape[0] // m
     val_s = jax.lax.dynamic_slice_in_dim(values, mi * rows, rows, 0)
     if implicit:
-        yty = jax.lax.psum(_factors_yty(opp_local), "model")
         return _gram_solve_implicit(
             g, val_s, yty, reg, alpha, rank, unroll, opp_local.dtype
         )
@@ -486,6 +562,21 @@ def _append_zero_row(factors: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate(
         [factors, jnp.zeros((1, factors.shape[1]), factors.dtype)], axis=0
     )
+
+
+def resolve_solver(solver: str, platform: str) -> str:
+    """Resolve ``ALSConfig.solver`` against a target platform -- ONE
+    definition of the "auto" rule (make_iteration and bench.py must agree
+    on which path a run measured): pallas on accelerators, xla on CPU
+    meshes, where the fused kernel would only run interpreted."""
+    if solver not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            "ALSConfig.solver must be 'auto', 'xla' or 'pallas', "
+            f"got {solver!r}"
+        )
+    if solver == "auto":
+        return "xla" if platform == "cpu" else "pallas"
+    return solver
 
 
 def make_iteration(mesh, config: ALSConfig):
@@ -502,14 +593,19 @@ def make_iteration(mesh, config: ALSConfig):
             "ALSConfig.factor_sharding must be 'replicated' or 'model', "
             f"got {config.factor_sharding!r}"
         )
+    # per TARGET platform, like the unrolled-vs-LAPACK solve split: the
+    # fused kernel is built for the MXU+DMA engines; on CPU it would run
+    # interpreted (a correctness vehicle), so auto keeps the CPU default
+    # on the einsum path
+    solver = resolve_solver(config.solver, mesh.devices.flat[0].platform)
     return _build_iteration(
-        mesh, config.rank, config.implicit, config.factor_sharding
+        mesh, config.rank, config.implicit, config.factor_sharding, solver
     )
 
 
 @cached_by_mesh(maxsize=32)
 def _build_iteration(mesh, rank: int, implicit: bool,
-                     factor_axis: str = "replicated"):
+                     factor_axis: str = "replicated", solver: str = "xla"):
     """Build the jitted full ALS iteration (both half-steps fused).
 
     CSR rows (every bucket) shard over the 'data' mesh axis. Factor
@@ -526,6 +622,14 @@ def _build_iteration(mesh, rank: int, implicit: bool,
       matrix: per-device factor memory is total_slots/m rows, which is
       what lifts the catalog-size ceiling from one device's HBM to the
       model axis's aggregate (docs/parallelism.md has the sizing math).
+
+    ``solver`` (already resolved, "xla" or "pallas") picks the half-step
+    tail: the einsum path GSPMD partitions on its own; the fused Pallas
+    kernel (``ops.als_gram``) is opaque to GSPMD, so both factor layouts
+    route it through an explicit shard_map (interpret mode on CPU meshes,
+    the ``ops/flash_attention`` precedent -- tier-1 CPU tests run the same
+    kernel code). Implicit mode's ``yty`` is computed ONCE per half-step
+    here (bucket-invariant) and fed to every bucket's solve.
 
     Factor buffers are donated: each iteration updates in place instead
     of reallocating.
@@ -548,30 +652,66 @@ def _build_iteration(mesh, rank: int, implicit: bool,
     # Any non-cpu platform counts as TPU-like: the axon tunnel backend
     # reports platform "axon" for real TPU chips.
     unroll = mesh.devices.flat[0].platform != "cpu"
+    interpret = mesh.devices.flat[0].platform == "cpu"
+
+    def side_yty(opp_real):
+        """Global factor Gram of one side (implicit mode), hoisted out of
+        the per-bucket loop; explicit mode feeds a dummy the steps drop."""
+        if implicit:
+            return _factors_yty(opp_real)
+        return jnp.zeros((rank, rank), jnp.float32)
 
     if factor_axis == "model":
         fsh = NamedSharding(mesh, P("model"))
         body = functools.partial(
-            _sharded_block_body, implicit=implicit, rank=rank, unroll=unroll
+            _sharded_block_body, implicit=implicit, rank=rank,
+            unroll=unroll, solver=solver, interpret=interpret,
         )
-        smapped = jax.shard_map(
+        smapped = shard_map(
             body,
             mesh=mesh,
             in_specs=(P("data", None), P("data", None), P("data"),
-                      P("model", None), P(), P()),
+                      P("model", None), P(), P(), P()),
             out_specs=P(("data", "model"), None),
+            # the pallas body has no replication/vma rule; the xla body
+            # keeps the checker on
+            check_vma=solver != "pallas",
         )
 
         def iteration(u_blocks, i_blocks, users, items, reg, alpha):
             def solve_side(blocks, opp):
+                # inter-bucket padding rows are zero and the sentinel is
+                # out of every shard, so the full sharded [S, K] Gram is
+                # the implicit global term (GSPMD psums it once per side)
+                yty = side_yty(opp)
                 outs = [
-                    smapped(idx, val, n_obs, opp, reg, alpha)
+                    smapped(idx, val, n_obs, opp, yty, reg, alpha)
                     for idx, val, n_obs in blocks
                 ]
-                out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, 0)
-                # reshard P(("data","model")) -> P("model"): the all-gather
-                # over 'data' that readies this side for the next gather
-                return jax.lax.with_sharding_constraint(out, fsh)
+                if len(outs) == 1:
+                    # reshard P(("data","model")) -> P("model"): the
+                    # all-gather over 'data' that readies this side for
+                    # the next gather
+                    return jax.lax.with_sharding_constraint(outs[0], fsh)
+                # multi-bucket assembly resharded PIECEWISE via
+                # dynamic_update_slice: jnp.concatenate of differently
+                # tuple-sharded bucket outputs followed by a reshard
+                # miscompiles under the legacy (0.4.x) GSPMD partitioner
+                # (values land in the wrong rows); updating each bucket's
+                # rows into a P("model") buffer keeps every reshard a
+                # single-array one, which partitions correctly on both
+                # APIs and lowers to the same all-gather traffic
+                total = sum(o.shape[0] for o in outs)
+                buf = jax.lax.with_sharding_constraint(
+                    jnp.zeros((total, outs[0].shape[1]), outs[0].dtype),
+                    fsh,
+                )
+                off = 0
+                for o in outs:
+                    piece = jax.lax.with_sharding_constraint(o, fsh)
+                    buf = jax.lax.dynamic_update_slice(buf, piece, (off, 0))
+                    off += o.shape[0]
+                return jax.lax.with_sharding_constraint(buf, fsh)
 
             users = solve_side(u_blocks, items)
             items = solve_side(i_blocks, users)
@@ -584,10 +724,27 @@ def _build_iteration(mesh, rank: int, implicit: bool,
             donate_argnums=(2, 3),
         )
 
+    if solver == "pallas":
+        pallas_step = functools.partial(
+            _half_step_pallas, implicit=implicit, rank=rank, unroll=unroll,
+            interpret=interpret,
+        )
+        smapped = shard_map(
+            pallas_step,
+            mesh=mesh,
+            in_specs=(P("data", None), P("data", None), P("data"),
+                      P(), P(), P(), P()),
+            out_specs=P("data", None),
+            check_vma=False,
+        )
+
     def iteration(u_blocks, i_blocks, users, items, reg, alpha):
-        if implicit:
+        if solver == "pallas":
+            step = smapped
+        elif implicit:
             step = functools.partial(
-                _half_step_implicit, reg=reg, alpha=alpha, rank=rank, unroll=unroll
+                _half_step_implicit, reg=reg, alpha=alpha, rank=rank,
+                unroll=unroll,
             )
         else:
             step = functools.partial(
@@ -595,7 +752,23 @@ def _build_iteration(mesh, rank: int, implicit: bool,
             )
 
         def solve_side(blocks, opp_full):
-            outs = [step(idx, val, n_obs, opp_full) for idx, val, n_obs in blocks]
+            if solver == "pallas":
+                yty = side_yty(opp_full[:-1])
+                outs = [
+                    step(idx, val, n_obs, opp_full, yty, reg, alpha)
+                    for idx, val, n_obs in blocks
+                ]
+            elif implicit:
+                yty = side_yty(opp_full[:-1])
+                outs = [
+                    step(idx, val, n_obs, opp_full, yty)
+                    for idx, val, n_obs in blocks
+                ]
+            else:
+                outs = [
+                    step(idx, val, n_obs, opp_full)
+                    for idx, val, n_obs in blocks
+                ]
             out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
             return jax.lax.with_sharding_constraint(out, row)
 

@@ -13,6 +13,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from predictionio_tpu.utils.jax_compat import shard_map
+
 
 def cached_by_mesh(maxsize: int = 32):
     """LRU cache for ``build(mesh, *static_args)`` program builders.
@@ -119,7 +121,7 @@ def seq_parallel_shard_map(body, mesh: Mesh, axis_name: str, check_vma: bool = T
     batch_axis = "data" if "data" in mesh.axis_names else None
     spec = P(batch_axis, axis_name, None, None)
     mspec = P(batch_axis, axis_name)
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
         check_vma=check_vma,
     )

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from predictionio_tpu.parallel.mesh import seq_parallel_shard_map
+from predictionio_tpu.utils.jax_compat import pcast_varying
 
 _NEG = -1e30  # finite "masked" score: keeps exp() NaN-free on all-masked rows
 
@@ -89,7 +90,7 @@ def _ring_attention_local(
 
     # fresh constants are "unvarying" under shard_map's vma tracking; the
     # scan carry must match the varying outputs, so cast them explicitly
-    pvary = lambda x: jax.lax.pcast(x, mesh_axes, to="varying") if mesh_axes else x
+    pvary = lambda x: pcast_varying(x, mesh_axes) if mesh_axes else x
     o0 = pvary(jnp.zeros((b, h, t_local, d), q.dtype))
     m0 = pvary(jnp.full((b, h, t_local), _NEG, q.dtype))
     l0 = pvary(jnp.zeros((b, h, t_local), q.dtype))

@@ -41,6 +41,15 @@ def register(sub: argparse._SubParsersAction) -> None:
         default=None,
         help="snapshot root (default $PIO_FS_BASEDIR/snapshots)",
     )
+    train.add_argument(
+        "--als-solver",
+        choices=("auto", "xla", "pallas"),
+        default=None,
+        help="ALS half-step tail: 'pallas' = fused gather->Gram TPU kernel"
+        " (no [rows, L, K] HBM intermediate), 'xla' = einsum path; default"
+        " auto (pallas on accelerators, xla on CPU). Overrides the"
+        " engine.json alsSolver param for this run",
+    )
     train.add_argument("passthrough", nargs="*", help="runtime conf after --")
     train.set_defaults(func=cmd_train)
 
@@ -125,6 +134,8 @@ def cmd_train(args: argparse.Namespace) -> int:
     if args.snapshot_dir:
         variant.runtime_conf["pio.snapshot_dir"] = args.snapshot_dir
         os.environ["PIO_SNAPSHOT_DIR"] = args.snapshot_dir
+    if args.als_solver:
+        variant.runtime_conf["pio.als_solver"] = args.als_solver
     params = WorkflowParams(
         batch=args.batch,
         skip_sanity_check=args.skip_sanity_check,

@@ -1,0 +1,76 @@
+"""Version-drift shims for the jax API surface this repo rides.
+
+The codebase targets the current jax API (``jax.shard_map``, varying-mesh-
+axes types, ``jax.lax.pcast``); the installed jax may predate it (0.4.x
+exposes ``shard_map`` only under ``jax.experimental`` with the vma checker
+named ``check_rep`` and no vma machinery at all). Every call site imports
+from HERE instead of feature-testing jax inline, so the drift policy lives
+in one module and the day the floor moves past the new API this file
+deletes down to three aliases.
+
+Mapping rules:
+
+- ``shard_map``: ``jax.shard_map`` when present, else
+  ``jax.experimental.shard_map.shard_map`` with the ``check_vma`` kwarg
+  renamed to its old spelling ``check_rep`` (same meaning: False disables
+  the output-replication/varying checker, which pallas-in-interpret bodies
+  trip on both APIs).
+- ``pcast_varying``: ``jax.lax.pcast(..., to="varying")`` when present,
+  else identity -- pre-vma jax has no varying/unvarying distinction, so a
+  fresh constant already has whatever type the checker expects.
+- ``shape_struct``: ``jax.ShapeDtypeStruct`` carrying the vma of a model
+  array (so pallas out_shapes compose under ``shard_map(check_vma=True)``)
+  when ``jax.typeof`` exists; the plain struct otherwise.
+"""
+
+from __future__ import annotations
+
+import jax
+
+#: True on pre-``jax.shard_map`` (0.4.x) installs. Gates the few behaviors
+#: the legacy stack MISCOMPILES rather than lacks: donating a tp-sharded
+#: optimizer-state pytree pairs donated buffers with wrong-shaped outputs
+#: inside XLA ("Expected aliased input ... to have the same size").
+IS_LEGACY_JAX = not hasattr(jax, "shard_map")
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+                  **kwargs):
+        """``jax.shard_map`` signature on the legacy experimental API."""
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kwargs,
+        )
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis (``jax.lax.axis_size``); the old
+    API spells it ``psum(1, name)``, which constant-folds to a python int
+    at trace time."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast_varying(x, axis_name):
+    """Cast a fresh constant to a "varying" collective type (scan carries
+    must match their varying body outputs under the vma checker); identity
+    on pre-vma jax, where constants and collectives share one type."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    return x
+
+
+def shape_struct(shape, dtype, like=None):
+    """ShapeDtypeStruct inheriting ``like``'s varying-mesh-axes, when the
+    installed jax tracks them; plain (non-sharded) callers and pre-vma jax
+    get the ordinary struct."""
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof and like is not None else None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)

@@ -11,6 +11,7 @@ from predictionio_tpu.parallel.distributed import (
     host_local_batch,
     init_distributed,
 )
+from predictionio_tpu.utils.jax_compat import shard_map
 from predictionio_tpu.workflow.context import RuntimeContext
 
 
@@ -125,7 +126,7 @@ def test_sharded_compute_on_hybrid_mesh():
     def body(x):
         return jax.lax.psum(x.sum(), "data")
 
-    out = jax.shard_map(
+    out = shard_map(
         body, mesh=mesh, in_specs=P("data"), out_specs=P()
     )(x)
     assert float(np.asarray(out)) == 32.0

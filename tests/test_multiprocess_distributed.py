@@ -20,6 +20,7 @@ _WORKER = textwrap.dedent(
     jax.config.update("jax_platforms", "cpu")
     from predictionio_tpu.parallel.distributed import (
         init_distributed, build_mesh, host_local_batch)
+    from predictionio_tpu.utils.jax_compat import shard_map
     import numpy as np
     from jax.sharding import PartitionSpec as P
 
@@ -30,8 +31,8 @@ _WORKER = textwrap.dedent(
     mesh = build_mesh([8, 1], ("data", "model"))
     x = host_local_batch(mesh, P("data"), np.full((8, 2), pid + 1, np.float32))
     assert x.shape == (16, 2)
-    total = jax.shard_map(lambda x: jax.lax.psum(x.sum(), "data"),
-                          mesh=mesh, in_specs=P("data"), out_specs=P())(x)
+    total = shard_map(lambda x: jax.lax.psum(x.sum(), "data"),
+                      mesh=mesh, in_specs=P("data"), out_specs=P())(x)
     assert float(np.asarray(total)) == 48.0, float(np.asarray(total))
     print("OK", flush=True)
     """
