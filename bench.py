@@ -475,6 +475,33 @@ def secondary_main(result_path: str) -> None:
         )
         return res
 
+    def analysis_findings():
+        """#10: the `pio check` static-analysis gate as a zero-cost
+        regression metric. `analysis_findings_total` (unsuppressed) must
+        stay 0 -- tier-1 gates it -- and `suppressed` (the committed
+        baseline) should only ever ratchet down. No JAX, identical on CPU
+        and TPU children."""
+        from predictionio_tpu.analysis.engine import (
+            apply_baseline,
+            check_paths,
+            load_baseline,
+        )
+
+        findings = check_paths()
+        unsuppressed, suppressed, stale = apply_baseline(
+            findings, load_baseline()
+        )
+        by_rule: dict = {}
+        for f in findings:
+            by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+        return {
+            "analysis_findings_total": len(unsuppressed),
+            "suppressed": len(suppressed),
+            "stale_baseline": len(stale),
+            "findings_by_rule": by_rule,
+            "config": "#10 analysis_findings (pio check --format json)",
+        }
+
     phase("naive_bayes_fit", nb_fit)
     phase("logreg_lbfgs_fit", logreg_fit)
     phase("cooccurrence_llr_indicators", cooc_indicators)
@@ -483,6 +510,7 @@ def secondary_main(result_path: str) -> None:
     phase("ingest_eps", ingest_eps)
     phase("train_data_eps", train_data_eps)
     phase("als_half_step_gbps", als_half_step_gbps)
+    phase("analysis_findings", analysis_findings)
 
 
 def child_main(mode: str, result_path: str) -> None:

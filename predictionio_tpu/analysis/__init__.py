@@ -1,0 +1,28 @@
+"""``pio check``: JAX-aware static analysis + concurrency lint.
+
+Rule families (catalog with incidents: ``docs/static_analysis.md``):
+
+- **J-series** (``rules_jax``): the jax version-drift and tracing
+  invariants -- drift-shim policy (J001), legacy donation miscompile
+  (J002), control flow on tracers (J003), host sync inside jit (J004),
+  the 0.4.37 concat+reshard GSPMD miscompile (J005).
+- **C-series** (``rules_concurrency``): lock-order cycles (C001),
+  blocking I/O under a lock (C002), cross-thread unlocked mutation (C003).
+
+``analysis/baseline.json`` suppresses accepted findings (with mandatory
+justifications); the tier-1 gate in ``tests/test_analysis.py`` asserts
+zero unsuppressed findings over the package. ``analysis/lockwatch.py`` is
+the runtime companion validating C001 against actual acquisition orders
+under pytest.
+"""
+
+from predictionio_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    all_rules,
+    apply_baseline,
+    check_paths,
+    load_baseline,
+    parse_source,
+    run_cli,
+    self_check,
+)

@@ -1,0 +1,9 @@
+"""``python -m predictionio_tpu.analysis [--self-check] [paths...]`` --
+the same engine ``pio check`` fronts, importable without the CLI."""
+
+import sys
+
+from predictionio_tpu.analysis.engine import run_cli
+
+if __name__ == "__main__":
+    sys.exit(run_cli())

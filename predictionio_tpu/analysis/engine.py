@@ -1,0 +1,437 @@
+"""Rule engine: parse the package, run the J/C rule families, report.
+
+The analyzer is deliberately dependency-free (``ast`` + a light lock-region
+walk, no typeshed, no import-time execution of the analyzed code): it has to
+run inside tier-1 on a 2-core box in single-digit seconds, and it encodes
+THIS repo's invariants -- the jax version-drift shim policy, the
+never-donate-sharded-optimizer-state rule, the no-blocking-I/O-under-a-lock
+rule -- not a general Python lint. See ``docs/static_analysis.md`` for the
+rule catalog and the incident each rule encodes.
+
+Baseline contract (``analysis/baseline.json``): accepted findings are keyed
+by ``(rule, path, symbol)`` -- line-independent, so unrelated edits don't
+churn the file -- and every entry carries a human justification. The
+tier-1 gate asserts zero UNSUPPRESSED findings; entries that no longer
+match any finding are "stale" and fail ``--self-check``, which is what
+makes the baseline a ratchet instead of a dumping ground.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field, asdict
+from typing import Iterable, Iterator
+
+#: severity ladder (sort order for reports)
+SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    severity: str
+    path: str          # repo-relative, posix separators
+    line: int
+    symbol: str        # enclosing "Class.method" / "func" / "<module>"
+    message: str
+    hint: str = ""
+
+    def key(self) -> tuple:
+        return (self.rule_id, self.path, self.symbol)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        hint = f" [fix: {self.hint}]" if self.hint else ""
+        return f"{loc}: {self.rule_id} {self.severity}: {self.message}{hint}"
+
+
+@dataclass
+class ModuleContext:
+    """One parsed file, shared by every rule."""
+
+    path: str                       # repo-relative
+    tree: ast.AST
+    source: str
+    symbols: dict = field(default_factory=dict)  # id(node) -> qualname
+
+    def symbol_for(self, node: ast.AST) -> str:
+        """Qualname of the innermost enclosing def/class, '<module>' else."""
+        return self.symbols.get(id(node), "<module>")
+
+
+def _index_symbols(tree: ast.AST) -> dict:
+    """Map every AST node to its enclosing Class.func qualname."""
+    out: dict = {}
+
+    def visit(node: ast.AST, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            q = qual
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+            out[id(child)] = q or "<module>"
+            visit(child, q)
+
+    visit(tree, "")
+    return out
+
+
+def package_root() -> str:
+    """The ``predictionio_tpu`` package directory (computed from this file:
+    the analyzer must not import the analyzed package)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    """Directory holding the ``predictionio_tpu`` package."""
+    return os.path.dirname(package_root())
+
+
+def iter_py_files(root: str) -> Iterator[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        # the analyzer sweep must never descend into bytecode caches or
+        # build output (repo-hygiene invariant, also enforced by .gitignore)
+        dirnames[:] = [
+            d for d in sorted(dirnames)
+            if d not in ("__pycache__", "_build", ".git")
+        ]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def parse_module(path: str, root: str | None = None) -> ModuleContext | None:
+    root = root or repo_root()
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError:
+        return None
+    ctx = ModuleContext(path=rel, tree=tree, source=source)
+    ctx.symbols = _index_symbols(tree)
+    return ctx
+
+
+def parse_source(source: str, path: str = "fixture.py") -> ModuleContext:
+    """Analyze an in-memory snippet (the rule-fixture test entry point)."""
+    tree = ast.parse(source, filename=path)
+    ctx = ModuleContext(path=path, tree=tree, source=source)
+    ctx.symbols = _index_symbols(tree)
+    return ctx
+
+
+def all_rules() -> list:
+    from predictionio_tpu.analysis import rules_concurrency, rules_jax
+
+    return [cls() for cls in rules_jax.RULES + rules_concurrency.RULES]
+
+
+def select_rules(rule_ids: Iterable[str] | None = None) -> list:
+    rules = all_rules()
+    if not rule_ids:
+        return rules
+    wanted = {r.upper() for r in rule_ids}
+    unknown = wanted - {r.rule_id for r in rules}
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+    return [r for r in rules if r.rule_id in wanted]
+
+
+def check_context(ctx: ModuleContext, rules: list) -> list[Finding]:
+    findings: list[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    return findings
+
+
+def check_paths(
+    paths: Iterable[str] | None = None, rules: list | None = None
+) -> list[Finding]:
+    """Run the rule set over files/directories; defaults to the package."""
+    rules = rules if rules is not None else all_rules()
+    root = repo_root()
+    files: list[str] = []
+    for p in paths or [package_root()]:
+        if os.path.isdir(p):
+            files.extend(iter_py_files(p))
+        else:
+            files.append(p)
+    findings: list[Finding] = []
+    for path in files:
+        ctx = parse_module(path, root)
+        if ctx is not None:
+            findings.extend(check_context(ctx, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return findings
+
+
+# -- baseline -----------------------------------------------------------------
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> list[dict]:
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("entries", [])
+    for e in entries:
+        for key in ("rule", "path", "symbol", "justification"):
+            if key not in e:
+                raise ValueError(f"baseline entry missing {key!r}: {e}")
+    return entries
+
+
+def apply_baseline(
+    findings: list[Finding], entries: list[dict]
+) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """Split findings into (unsuppressed, suppressed); also return entries
+    that matched nothing (stale -- the ratchet says delete them)."""
+    keys = {(e["rule"], e["path"], e["symbol"]): e for e in entries}
+    matched: set[tuple] = set()
+    unsuppressed, suppressed = [], []
+    for f in findings:
+        if f.key() in keys:
+            matched.add(f.key())
+            suppressed.append(f)
+        else:
+            unsuppressed.append(f)
+    stale = [e for k, e in keys.items() if k not in matched]
+    return unsuppressed, suppressed, stale
+
+
+def write_baseline(
+    findings: list[Finding],
+    path: str | None = None,
+    preserved: list[dict] | None = None,
+) -> int:
+    """Write a baseline covering every current finding, preserving existing
+    justifications; new entries get a TODO that ``--self-check`` rejects
+    until a human writes the real reason. ``preserved`` entries (the parts
+    of the old baseline a ``--rules``/path-scoped run did NOT re-examine)
+    are carried over verbatim instead of silently dropped."""
+    path = path or default_baseline_path()
+    old = {}
+    if os.path.exists(path):
+        old = {(e["rule"], e["path"], e["symbol"]): e for e in load_baseline(path)}
+    keys = {f.key() for f in findings}
+    keys |= {(e["rule"], e["path"], e["symbol"]) for e in (preserved or [])}
+    entries = []
+    for key in sorted(keys):
+        rule, fpath, symbol = key
+        prior = old.get(key)
+        entries.append({
+            "rule": rule,
+            "path": fpath,
+            "symbol": symbol,
+            "justification": prior["justification"] if prior else
+            "TODO: justify or fix",
+        })
+    doc = {"version": 1, "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return len(entries)
+
+
+# -- reports ------------------------------------------------------------------
+
+def render_text(
+    unsuppressed: list[Finding], suppressed: list[Finding], stale: list[dict]
+) -> str:
+    lines = [f.render() for f in unsuppressed]
+    if stale:
+        lines.append("")
+        lines.append("stale baseline entries (fixed findings -- delete them):")
+        lines.extend(
+            f"  {e['rule']} {e['path']} {e['symbol']}" for e in stale
+        )
+    lines.append("")
+    lines.append(
+        f"pio check: {len(unsuppressed)} finding(s), "
+        f"{len(suppressed)} baseline-suppressed, {len(stale)} stale entr"
+        f"{'y' if len(stale) == 1 else 'ies'}"
+    )
+    return "\n".join(lines).lstrip("\n")
+
+
+def render_json(
+    unsuppressed: list[Finding], suppressed: list[Finding], stale: list[dict]
+) -> str:
+    return json.dumps(
+        {
+            "findings": [asdict(f) for f in unsuppressed],
+            "suppressed": [asdict(f) for f in suppressed],
+            "stale_baseline": stale,
+            "analysis_findings_total": len(unsuppressed),
+        },
+        indent=2,
+    )
+
+
+def self_check(baseline_path: str | None = None) -> list[str]:
+    """Cheap integrity pass: rules compile and are well-formed, every
+    baseline entry still matches a real finding and carries a real
+    justification. Returns a list of problems (empty = healthy)."""
+    problems: list[str] = []
+    rules = all_rules()
+    seen_ids: set[str] = set()
+    for rule in rules:
+        if not rule.rule_id or rule.rule_id in seen_ids:
+            problems.append(f"bad/duplicate rule id on {type(rule).__name__}")
+        seen_ids.add(rule.rule_id)
+        if rule.severity not in SEVERITIES:
+            problems.append(f"{rule.rule_id}: bad severity {rule.severity!r}")
+        if not getattr(rule, "check", None):
+            problems.append(f"{rule.rule_id}: no check()")
+    try:
+        entries = load_baseline(baseline_path)
+    except (ValueError, json.JSONDecodeError) as exc:
+        return problems + [f"baseline unreadable: {exc}"]
+    findings = check_paths(rules=rules)
+    _, _, stale = apply_baseline(findings, entries)
+    for e in stale:
+        problems.append(
+            f"stale baseline entry (no matching finding -- delete it): "
+            f"{e['rule']} {e['path']} {e['symbol']}"
+        )
+    for e in entries:
+        just = e.get("justification", "").strip()
+        if not just or just.startswith("TODO"):
+            problems.append(
+                f"baseline entry lacks a justification: "
+                f"{e['rule']} {e['path']} {e['symbol']}"
+            )
+    return problems
+
+
+def add_check_arguments(parser) -> None:
+    """The ``pio check`` flag surface, defined ONCE -- shared by the
+    standalone CLI (``python -m predictionio_tpu.analysis``) and the
+    ``pio check`` subcommand in ``tools/engine_commands.py``."""
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to analyze (default: the predictionio_tpu package)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default: predictionio_tpu/analysis/baseline.json;"
+        " 'none' disables suppression)",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to cover every current finding "
+        "(existing justifications preserved; new entries get a TODO "
+        "that --self-check rejects)",
+    )
+    parser.add_argument(
+        "--self-check", action="store_true",
+        help="verify rules compile and baseline entries still correspond "
+        "to real findings",
+    )
+
+
+def _scope(paths: list[str]) -> tuple[set[str], list[str]] | None:
+    """CLI paths normalized to repo-relative (files, dirs); None = full run."""
+    if not paths:
+        return None
+    root = repo_root()
+
+    def rel(p: str) -> str:
+        return os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+
+    files = {rel(p) for p in paths if not os.path.isdir(p)}
+    dirs = [rel(p) for p in paths if os.path.isdir(p)]
+    return files, dirs
+
+
+def _entry_in_scope(entry: dict, ran: set[str], scope) -> bool:
+    """Did this run re-examine the code a baseline entry points at? Only
+    in-scope entries may be reported stale or rewritten; the rest of the
+    baseline is carried through untouched."""
+    if entry["rule"] not in ran:
+        return False
+    if scope is None:
+        return True
+    files, dirs = scope
+    return entry["path"] in files or any(
+        entry["path"] == d or entry["path"].startswith(d + "/") for d in dirs
+    )
+
+
+def run_with_args(args) -> int:
+    """Execute a parsed ``pio check`` invocation."""
+    if args.self_check:
+        problems = self_check(
+            None if args.baseline in (None, "none") else args.baseline
+        )
+        if problems:
+            for p in problems:
+                print(f"self-check: {p}")
+            return 1
+        print("self-check OK: rules compile, baseline entries all live")
+        return 0
+
+    try:
+        rules = select_rules(
+            [r for r in (args.rules or "").split(",") if r.strip()] or None
+        )
+    except ValueError as exc:
+        print(f"Error: {exc}")
+        return 2
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"Error: no such file or directory: {', '.join(missing)}")
+        return 2
+    findings = check_paths(args.paths or None, rules)
+    ran = {r.rule_id for r in rules}
+    scope = _scope(args.paths)
+    if args.update_baseline:
+        if args.baseline == "none":
+            print("Error: --update-baseline with --baseline none makes no sense")
+            return 2
+        # a --rules/path-scoped run rewrites only what it re-examined; the
+        # rest of the baseline (other rules, other paths -- and their
+        # human-written justifications) is preserved verbatim
+        preserved = [
+            e for e in load_baseline(args.baseline)
+            if not _entry_in_scope(e, ran, scope)
+        ]
+        n = write_baseline(findings, args.baseline, preserved=preserved)
+        print(f"baseline rewritten: {n} entr{'y' if n == 1 else 'ies'}")
+        return 0
+    entries = [] if args.baseline == "none" else load_baseline(args.baseline)
+    # out-of-scope entries (unrun rules / unanalyzed paths) must not be
+    # reported stale: this run produced no evidence about them
+    entries = [e for e in entries if _entry_in_scope(e, ran, scope)]
+    unsuppressed, suppressed, stale = apply_baseline(findings, entries)
+    if args.format == "json":
+        print(render_json(unsuppressed, suppressed, stale))
+    else:
+        print(render_text(unsuppressed, suppressed, stale))
+    return 1 if (unsuppressed or stale) else 0
+
+
+def run_cli(argv: list[str] | None = None) -> int:
+    """Shared implementation of ``pio check`` and
+    ``python -m predictionio_tpu.analysis``."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="pio check",
+        description="JAX-aware static analysis + concurrency lint "
+        "(rule catalog: docs/static_analysis.md)",
+    )
+    add_check_arguments(parser)
+    return run_with_args(parser.parse_args(argv))

@@ -1,0 +1,360 @@
+"""C-series rules: lock ordering, blocking-I/O-under-lock, unlocked shared
+mutation. Built on a light lock-region walk (lexical ``with <lock>:``
+nesting plus one level of intra-module call propagation) -- not a full CFG,
+but exactly the shapes the PR-2/PR-3 races took.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from predictionio_tpu.analysis.astutil import call_name, dotted, keyword, walk_calls
+from predictionio_tpu.analysis.engine import Finding, ModuleContext
+
+#: C003's blast radius: the modules whose state is touched by both request
+#: threads and background writer/flusher threads
+C003_SCOPE = (
+    "data/ingest.py",
+    "data/wal.py",
+    "data/snapshot.py",
+    "workflow/microbatch.py",
+    "utils/metrics.py",
+)
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+#: attribute calls that mutate a container in place
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "remove", "clear", "add",
+    "discard", "update", "setdefault", "popitem",
+}
+
+
+def _lock_index(ctx: ModuleContext) -> "_LockIndex":
+    """One _LockIndex per module, shared by the three C rules."""
+    cached = ctx.symbols.get("__lock_index__")
+    if cached is None:
+        cached = _LockIndex(ctx)
+        ctx.symbols["__lock_index__"] = cached
+    return cached
+
+
+def _lock_id(expr: ast.AST) -> str | None:
+    """Normalize a lock reference: ``self._lock`` -> ``_lock``, a bare
+    module-level ``_lock`` stays ``_lock``."""
+    d = dotted(expr)
+    if d is None:
+        return None
+    if d.startswith("self."):
+        return d[len("self."):]
+    return d
+
+
+class _LockIndex:
+    """Per-module lock inventory + per-function lock-region facts."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.locks: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if call_name(node.value) in _LOCK_CTORS:
+                    for t in node.targets:
+                        lid = _lock_id(t)
+                        if lid:
+                            self.locks.add(lid)
+        #: qualname -> _FuncFacts
+        self.funcs: dict[str, "_FuncFacts"] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # symbols[] maps a def to its own qualname ("Class.method")
+                qual = ctx.symbols.get(id(node), node.name)
+                facts = _FuncFacts(qual, node)
+                _walk_regions(node, self.locks, facts)
+                self.funcs[qual] = facts
+
+    def lookup(self, caller_qual: str, callee: str) -> "_FuncFacts | None":
+        """Resolve ``self.foo()`` / ``foo()`` to a function in this module;
+        prefers the caller's own class."""
+        if callee.startswith("self."):
+            name = callee[len("self."):]
+            cls = caller_qual.rsplit(".", 1)[0] if "." in caller_qual else ""
+            hit = self.funcs.get(f"{cls}.{name}")
+            if hit is not None:
+                return hit
+            for qual, facts in self.funcs.items():
+                if qual.endswith(f".{name}"):
+                    return facts
+            return None
+        return self.funcs.get(callee)
+
+
+@dataclass
+class _FuncFacts:
+    qual: str
+    node: ast.AST
+    #: (lock, frozenset(held), line) at each with-acquisition
+    acquisitions: list = field(default_factory=list)
+    #: (reason, frozenset(held), line) for blocking calls
+    blocking: list = field(default_factory=list)
+    #: (callee dotted name, frozenset(held), line) for calls made
+    calls: list = field(default_factory=list)
+    #: (attr, frozenset(held), line) for self-attribute mutations
+    mutations: list = field(default_factory=list)
+
+
+def _walk_regions(fn: ast.AST, locks: set[str], facts: _FuncFacts) -> None:
+    def visit(node: ast.AST, held: tuple) -> None:
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                lid = _lock_id(item.context_expr)
+                if lid is not None and lid in locks:
+                    facts.acquisitions.append((lid, frozenset(held), node.lineno))
+                    acquired.append(lid)
+            inner = held + tuple(a for a in acquired if a not in held)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            return  # nested defs run on their own call stack
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            # lock.acquire() outside a with-statement counts as an
+            # acquisition event (region tracking stays with-based)
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "acquire":
+                lid = _lock_id(node.func.value)
+                if lid in locks:
+                    facts.acquisitions.append((lid, frozenset(held), node.lineno))
+            reason = _blocking_reason(node)
+            if reason is not None:
+                facts.blocking.append((reason, frozenset(held), node.lineno))
+            if name and (name.startswith("self.") or name in ("",) or "." not in name):
+                facts.calls.append((name, frozenset(held), node.lineno))
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+            ):
+                recv = dotted(node.func.value) or ""
+                if recv.startswith("self.") and recv.count(".") == 1:
+                    facts.mutations.append(
+                        (recv[len("self."):], frozenset(held), node.lineno)
+                    )
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                d = dotted(t)
+                if d and d.startswith("self.") and d.count(".") == 1:
+                    facts.mutations.append(
+                        (d[len("self."):], frozenset(held), node.lineno)
+                    )
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in ast.iter_child_nodes(fn):
+        visit(stmt, ())
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    name = call_name(call)
+    if name == "os.fsync":
+        return "os.fsync"
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr == "fsync":
+            return "fsync"
+        if attr in ("execute", "executemany", "commit", "rollback"):
+            return f"SQL .{attr}()"
+        if attr in ("connect", "sendall", "recv", "accept", "makefile"):
+            return f"socket .{attr}()"
+        if attr in ("put", "get"):
+            recv = (dotted(call.func.value) or "").lower()
+            if "queue" in recv or recv in ("q", "self.q"):
+                if keyword(call, "timeout") is not None:
+                    return None
+                block_kw = keyword(call, "block")
+                if block_kw is not None and isinstance(
+                    block_kw.value, ast.Constant
+                ) and block_kw.value.value is False:
+                    return None
+                return f"blocking queue .{attr}() without timeout"
+    if name == "time.sleep":
+        return "time.sleep"
+    if name in ("urllib.request.urlopen", "urlopen"):
+        return "urlopen"
+    return None
+
+
+class RuleC001:
+    """Inconsistent lock-acquisition order (cycle in the module's lock
+    graph). Incident class: the PR-2/PR-3 snapshot-GC and checkpoint-
+    ordering races; a cycle here is a deadlock waiting for the right
+    interleaving. Validated at runtime by ``analysis/lockwatch.py``."""
+
+    rule_id = "C001"
+    severity = "error"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        index = _lock_index(ctx)
+        if len(index.locks) < 2:
+            return
+        # edges: lock A held while acquiring lock B (direct + one level of
+        # intra-module call propagation)
+        edges: dict[tuple[str, str], int] = {}
+        for facts in index.funcs.values():
+            for lock, held, line in facts.acquisitions:
+                for h in held:
+                    if h != lock:
+                        edges.setdefault((h, lock), line)
+            for callee, held, line in facts.calls:
+                if not held:
+                    continue
+                target = index.lookup(facts.qual, callee)
+                if target is None:
+                    continue
+                for lock, _, _ in target.acquisitions:
+                    for h in held:
+                        if h != lock:
+                            edges.setdefault((h, lock), line)
+        reported: set[frozenset] = set()
+        for (a, b), line in sorted(edges.items(), key=lambda kv: kv[1]):
+            if (b, a) in edges and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                yield Finding(
+                    self.rule_id, self.severity, ctx.path, line,
+                    "<module>",
+                    f"inconsistent lock order: {a!r} -> {b!r} (line {line}) "
+                    f"but also {b!r} -> {a!r} (line {edges[(b, a)]})",
+                    "pick one global acquisition order and restructure the "
+                    "second site to follow it",
+                )
+
+
+class RuleC002:
+    """Blocking I/O while holding a lock. Incident: the WAL held its writer
+    lock across the group-commit fsync, serializing appenders behind disk
+    latency; same shape as fsync-under-lock in the snapshot store."""
+
+    rule_id = "C002"
+    severity = "warning"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        index = _lock_index(ctx)
+        if not index.locks:
+            return
+        for facts in index.funcs.values():
+            for reason, held, line in facts.blocking:
+                if not held:
+                    continue
+                yield Finding(
+                    self.rule_id, self.severity, ctx.path, line,
+                    facts.qual,
+                    f"blocking call ({reason}) while holding "
+                    f"{', '.join(sorted(held))}",
+                    "move the blocking call outside the critical section "
+                    "(capture state under the lock, do I/O after release)",
+                )
+
+
+class RuleC003:
+    """A field mutated from two threads' entry points with no common lock.
+    Scoped to the modules where request threads and background writers
+    share state. Entry points: ``threading.Thread(target=self.X)`` methods
+    (background) vs public methods (request threads)."""
+
+    rule_id = "C003"
+    severity = "error"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not any(ctx.path.endswith(s) for s in C003_SCOPE):
+            return
+        index = _lock_index(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, index, node)
+
+    def _check_class(self, ctx, index, cls: ast.ClassDef):
+        cls_qual = ctx.symbols.get(id(cls), cls.name)
+        methods = {
+            q.rsplit(".", 1)[1]: f
+            for q, f in index.funcs.items()
+            if q.startswith(f"{cls_qual}.") and q.count(".") == cls_qual.count(".") + 1
+        }
+        bg_roots = set()
+        for call in walk_calls(cls):
+            if call_name(call).endswith("Thread"):
+                kw = keyword(call, "target")
+                if kw is not None:
+                    d = dotted(kw.value) or ""
+                    if d.startswith("self."):
+                        bg_roots.add(d[len("self."):])
+        if not bg_roots:
+            return
+        fg_roots = {
+            name for name in methods
+            if not name.startswith("_") and name not in bg_roots
+        }
+        # attr -> root kind -> list of locksets observed at mutation sites
+        observed: dict[str, dict[str, list]] = {}
+        lines: dict[str, int] = {}
+        for kind, roots in (("bg", bg_roots), ("fg", fg_roots)):
+            for root in roots:
+                for attr, held, line in self._reachable_mutations(
+                    index, cls_qual, methods, root
+                ):
+                    if attr in index.locks:
+                        continue
+                    observed.setdefault(attr, {}).setdefault(kind, []).append(held)
+                    lines.setdefault(attr, line)
+        for attr, by_kind in sorted(observed.items()):
+            if "bg" not in by_kind or "fg" not in by_kind:
+                continue
+            locksets = by_kind["bg"] + by_kind["fg"]
+            common = set(locksets[0])
+            for ls in locksets[1:]:
+                common &= set(ls)
+            if common:
+                continue
+            yield Finding(
+                self.rule_id, self.severity, ctx.path, lines[attr],
+                cls_qual,
+                f"field {attr!r} is mutated from both a background-thread "
+                "entry point and a public (request-thread) method without a "
+                "common lock",
+                "guard every mutation site with one shared lock, or confine "
+                "the field to a single thread",
+            )
+
+    def _reachable_mutations(self, index, cls_qual, methods, root):
+        """Mutations reachable from ``root`` (BFS over self-calls within
+        the class, two levels deep), each with the locks held along the
+        path. ``__init__`` is excluded: it happens-before thread start."""
+        out = []
+        seen: set[tuple[str, frozenset]] = set()
+        queue: list[tuple[str, frozenset, int]] = [(root, frozenset(), 0)]
+        while queue:
+            name, path_held, depth = queue.pop(0)
+            if name == "__init__" or (name, path_held) in seen:
+                continue
+            seen.add((name, path_held))
+            facts = methods.get(name)
+            if facts is None:
+                continue
+            for attr, held, line in facts.mutations:
+                out.append((attr, frozenset(path_held | held), line))
+            if depth >= 2:
+                continue
+            for callee, held, _ in facts.calls:
+                if callee.startswith("self."):
+                    queue.append(
+                        (callee[len("self."):], frozenset(path_held | held), depth + 1)
+                    )
+        return out
+
+
+RULES = (RuleC001, RuleC002, RuleC003)

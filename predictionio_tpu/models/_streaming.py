@@ -170,12 +170,11 @@ def _agree_until_time(handle: StreamingHandle) -> None:
         if jax.process_count() <= 1:
             return
         import numpy as np
-        from jax.experimental import multihost_utils
+
+        from predictionio_tpu.utils.jax_compat import broadcast_one_to_all
 
         local_us = int(until.timestamp() * 1e6)
-        agreed_us = int(
-            multihost_utils.broadcast_one_to_all(np.int64(local_us))
-        )
+        agreed_us = int(broadcast_one_to_all(np.int64(local_us)))
         # EVERY rank adopts the reconstructed value -- rank 0 included:
         # int(timestamp()*1e6) can truncate 1us below the original
         # datetime, so keeping the original on rank 0 could still put its

@@ -18,7 +18,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
+
+from predictionio_tpu.utils.jax_compat import pallas as pl
 
 # 1024 = XLA's tile for 1-D f32 arrays (8 sublanes x 128 lanes): the
 # kernel's output block must match it exactly -- real TPU lowering rejects

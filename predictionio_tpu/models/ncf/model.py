@@ -26,7 +26,7 @@ from predictionio_tpu.parallel.mesh import (
     fetch_global,
     put_global,
 )
-from predictionio_tpu.utils.jax_compat import IS_LEGACY_JAX
+from predictionio_tpu.utils.jax_compat import IS_LEGACY_JAX, broadcast_one_to_all
 
 
 @dataclass
@@ -153,9 +153,7 @@ def train_ncf(
     latest = checkpoint.latest_step() if checkpoint is not None else None
     any_checkpoint = checkpoint is not None
     if n_proc > 1:
-        from jax.experimental import multihost_utils
-
-        flags = multihost_utils.broadcast_one_to_all(
+        flags = broadcast_one_to_all(
             np.int64([1 if any_checkpoint else 0, -1 if latest is None else latest])
         )
         any_checkpoint = bool(int(flags[0]))
@@ -169,7 +167,7 @@ def train_ncf(
         if checkpoint is not None:
             host_state = checkpoint.restore(host_state)
         if n_proc > 1:
-            host_state = multihost_utils.broadcast_one_to_all(host_state)
+            host_state = broadcast_one_to_all(host_state)
         params = jax.tree_util.tree_map(put_global, host_state["params"], p_shard)
         # restore Adam's moments too -- a zeroed mu/nu after resume would
         # spike the first post-resume updates

@@ -31,6 +31,7 @@ from predictionio_tpu.parallel.mesh import (
     fetch_global,
     put_global,
 )
+from predictionio_tpu.utils.jax_compat import IS_LEGACY_JAX
 from predictionio_tpu.ops.flash_attention import flash_attention
 from predictionio_tpu.parallel.ring_attention import plain_attention, ring_attention
 from predictionio_tpu.parallel.ulysses import ulysses_attention
@@ -211,7 +212,12 @@ def train_sasrec(
         make_train_step(model, optimizer),
         in_shardings=(rep, None, {"seq": seq_shard, "target": seq_shard}, None),
         out_shardings=(rep, None, rep),
-        donate_argnums=(0, 1),
+        # same legacy-jax hazard the NCF trainer hit (pio check J002):
+        # donating the adam-state pytree under sharded placement pairs
+        # donated buffers with wrong-shaped outputs in old XLA. Params
+        # carry the bulk of the memory; moments re-donate once the floor
+        # moves past the fixed runtime
+        donate_argnums=(0,) if IS_LEGACY_JAX else (0, 1),
     )
 
     inputs = sequences.astype(np.int32)

@@ -55,10 +55,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from predictionio_tpu.utils.jax_compat import shape_struct
+from predictionio_tpu.utils.jax_compat import (
+    pallas as pl,
+    pallas_tpu as pltpu,
+    shape_struct,
+)
 
 #: rows per grid step (a CAP: the largest power of two <= this that divides
 #: the block's rows is used, so a 24-row block split over a 2-device data

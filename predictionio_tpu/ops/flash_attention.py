@@ -41,9 +41,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
-from predictionio_tpu.utils.jax_compat import shape_struct
+from predictionio_tpu.utils.jax_compat import pallas as pl, shape_struct
 
 _NEG = -1e30  # matches plain_attention's finite masked-score constant
 

@@ -147,7 +147,7 @@ def build_mesh(
                 f"dcn_mesh_shape {dcn_mesh_shape} and mesh_axes {axes} have "
                 "different ranks"
             )
-        from jax.experimental import mesh_utils
+        from predictionio_tpu.utils.jax_compat import create_hybrid_device_mesh
 
         dcn_total = _prod(dcn_mesh_shape)
         if len(devices) % dcn_total:
@@ -167,7 +167,7 @@ def build_mesh(
             )
         # TPU slices carry slice_index; CPU/virtual devices don't, so the
         # DCN granule degrades to the process there (the CI/test path)
-        grid = mesh_utils.create_hybrid_device_mesh(
+        grid = create_hybrid_device_mesh(
             resolved,
             dcn_mesh_shape,
             devices=devices,

@@ -51,9 +51,9 @@ def fetch_global(arr) -> np.ndarray:
     """Host copy of a (possibly multi-process) sharded array: allgathers
     across processes when local devices cannot address every shard."""
     if jax.process_count() > 1 and not arr.is_fully_replicated:
-        from jax.experimental import multihost_utils
+        from predictionio_tpu.utils.jax_compat import process_allgather
 
-        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+        return np.asarray(process_allgather(arr, tiled=True))
     return np.asarray(arr)
 
 

@@ -632,10 +632,10 @@ def distinct_user_counts_sharded(s: ShardedPaddedCSR) -> np.ndarray:
 
     local = distinct_user_counts(s.local)
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        from predictionio_tpu.utils.jax_compat import process_allgather
 
         return np.asarray(
-            multihost_utils.process_allgather(local)
+            process_allgather(local)
         ).reshape(jax.process_count(), -1).sum(axis=0).astype(np.float32)
     return local
 

@@ -99,6 +99,16 @@ def register(sub: argparse._SubParsersAction) -> None:
     ev.add_argument("--output-path", default=None, help="also write results JSON here")
     ev.set_defaults(func=cmd_eval)
 
+    from predictionio_tpu.analysis.engine import add_check_arguments
+
+    check = sub.add_parser(
+        "check",
+        help="static analysis: jax drift-shim + concurrency lint "
+        "(rule catalog: docs/static_analysis.md)",
+    )
+    add_check_arguments(check)
+    check.set_defaults(func=cmd_check)
+
     bp = sub.add_parser("batchpredict", help="bulk offline predictions")
     _add_variant_args(bp)
     bp.add_argument("--input", required=True, help="JSON-lines query file")
@@ -279,6 +289,12 @@ def cmd_eval(args: argparse.Namespace) -> int:
         print(f"Results written to {args.output_path}")
     print(f"Evaluation instance ID: {instance.id}")
     return 0
+
+
+def cmd_check(args: argparse.Namespace) -> int:
+    from predictionio_tpu.analysis.engine import run_with_args
+
+    return run_with_args(args)
 
 
 def cmd_batchpredict(args: argparse.Namespace) -> int:

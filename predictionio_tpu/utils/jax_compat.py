@@ -21,6 +21,15 @@ Mapping rules:
 - ``shape_struct``: ``jax.ShapeDtypeStruct`` carrying the vma of a model
   array (so pallas out_shapes compose under ``shard_map(check_vma=True)``)
   when ``jax.typeof`` exists; the plain struct otherwise.
+- ``pallas`` / ``pallas_tpu``: the Pallas modules, resolved through module
+  ``__getattr__`` so importing this shim stays cheap for callers that only
+  need ``IS_LEGACY_JAX`` (Pallas pulls in Mosaic lowering machinery).
+- ``broadcast_one_to_all`` / ``process_allgather`` /
+  ``create_hybrid_device_mesh``: lazy fronts for the multihost/mesh utils
+  that still live under ``jax.experimental`` on every supported jax.
+
+``pio check`` rule J001 enforces that every ``jax.experimental`` /
+``jax.shard_map`` / ``pjit`` touch in the package routes through here.
 """
 
 from __future__ import annotations
@@ -63,6 +72,46 @@ def pcast_varying(x, axis_name):
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis_name, to="varying")
     return x
+
+
+def __getattr__(name: str):
+    """Lazy module attributes (PEP 562): ``from ...jax_compat import
+    pallas as pl`` works, but callers that never touch Pallas never pay
+    its import."""
+    if name == "pallas":
+        from jax.experimental import pallas
+
+        globals()[name] = pallas
+        return pallas
+    if name == "pallas_tpu":
+        from jax.experimental.pallas import tpu as pallas_tpu
+
+        globals()[name] = pallas_tpu
+        return pallas_tpu
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def broadcast_one_to_all(x):
+    """One value (array or pytree) from process 0 to every process."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(x)
+
+
+def process_allgather(x, tiled: bool = False):
+    """Gather per-process values onto every host as a numpy array."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=tiled)
+
+
+def create_hybrid_device_mesh(mesh_shape, dcn_mesh_shape, devices=None, **kwargs):
+    """ICI-adjacency-preserving device grid for multi-slice meshes."""
+    from jax.experimental import mesh_utils
+
+    return mesh_utils.create_hybrid_device_mesh(
+        mesh_shape, dcn_mesh_shape, devices=devices, **kwargs
+    )
 
 
 def shape_struct(shape, dtype, like=None):

@@ -25,6 +25,46 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def _lockwatch_enabled() -> bool:
+    return os.environ.get("PIO_LOCKWATCH", "1") != "0"
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockwatch_session():
+    """Runtime validation of the static C001 rule (``pio check``): every
+    predictionio_tpu-constructed lock is watched for the whole suite, so an
+    acquisition-order inversion anywhere in tier-1 surfaces as a test
+    failure even when the timing never actually deadlocks.
+    ``PIO_LOCKWATCH=0`` opts out."""
+    if not _lockwatch_enabled():
+        yield
+        return
+    from predictionio_tpu.analysis import lockwatch
+
+    lockwatch.install()
+    yield
+    lockwatch.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _lockwatch_inversions(_lockwatch_session):
+    """Fail the test during which a lock-order inversion was first
+    observed (background threads charge their inversions to whichever
+    test is running -- close enough to localize the bug)."""
+    if not _lockwatch_enabled():
+        yield
+        return
+    from predictionio_tpu.analysis import lockwatch
+
+    watch = lockwatch.global_watch()
+    before = len(watch.inversions)
+    yield
+    fresh = watch.inversions[before:]
+    assert not fresh, "lock-order inversion(s) observed: " + "; ".join(
+        inv.detail for inv in fresh
+    )
+
+
 @pytest.fixture()
 def storage_env(tmp_path, monkeypatch):
     """Point the storage registry at a fresh sqlite file per test."""

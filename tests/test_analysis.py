@@ -1,0 +1,712 @@
+"""Rule-engine tests: every J/C rule fires on its seeded bug pattern and
+stays silent on the corrected form, the lockwatch runtime detector catches
+a seeded acquisition-order inversion, the baseline machinery ratchets, and
+the repo-wide zero-unsuppressed-findings gate (tier-1)."""
+
+import json
+import textwrap
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.analysis import (
+    Finding,
+    apply_baseline,
+    check_paths,
+    load_baseline,
+    parse_source,
+    self_check,
+)
+from predictionio_tpu.analysis import lockwatch
+from predictionio_tpu.analysis.rules_concurrency import (
+    RuleC001,
+    RuleC002,
+    RuleC003,
+)
+from predictionio_tpu.analysis.rules_jax import (
+    RuleJ001,
+    RuleJ002,
+    RuleJ003,
+    RuleJ004,
+    RuleJ005,
+)
+
+
+def run_rule(rule_cls, src: str, path: str = "predictionio_tpu/pkg/mod.py"):
+    ctx = parse_source(textwrap.dedent(src), path)
+    return list(rule_cls().check(ctx))
+
+
+# -- J001: drift-shim policy --------------------------------------------------
+
+class TestJ001:
+    def test_fires_on_experimental_import(self):
+        hits = run_rule(RuleJ001, """
+            from jax.experimental.shard_map import shard_map
+        """)
+        assert [f.rule_id for f in hits] == ["J001"]
+
+    def test_fires_on_experimental_submodule_and_attribute(self):
+        hits = run_rule(RuleJ001, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def f(x):
+                return jax.experimental.multihost_utils.broadcast_one_to_all(x)
+        """)
+        assert len(hits) == 2
+
+    def test_fires_on_jax_shard_map_and_pjit(self):
+        hits = run_rule(RuleJ001, """
+            import jax
+
+            def f(body, mesh):
+                return jax.shard_map(body, mesh=mesh)
+
+            from jax import pjit
+        """)
+        assert len(hits) == 2
+
+    def test_silent_on_shim_routed_import(self):
+        assert run_rule(RuleJ001, """
+            from predictionio_tpu.utils.jax_compat import shard_map, pallas as pl
+        """) == []
+
+    def test_shim_module_itself_exempt(self):
+        assert run_rule(RuleJ001, """
+            from jax.experimental.shard_map import shard_map
+        """, path="predictionio_tpu/utils/jax_compat.py") == []
+
+
+# -- J002: legacy donation of sharded optimizer state -------------------------
+
+_J002_BUG = """
+    import jax
+    from jax.sharding import NamedSharding
+
+    def make_train_step(model, optimizer):
+        def train_step(params, opt_state, batch, rng):
+            return params, opt_state
+        return train_step
+
+    def train(model, optimizer, rep):
+        step_fn = jax.jit(
+            make_train_step(model, optimizer),
+            in_shardings=(rep, None, None, None),
+            donate_argnums=(0, 1),
+        )
+        return step_fn
+"""
+
+_J002_FIXED = _J002_BUG.replace(
+    "donate_argnums=(0, 1),",
+    "donate_argnums=(0,) if IS_LEGACY_JAX else (0, 1),",
+)
+
+
+class TestJ002:
+    def test_fires_on_ungated_opt_state_donation(self):
+        hits = run_rule(RuleJ002, _J002_BUG)
+        assert [f.rule_id for f in hits] == ["J002"]
+        assert "opt_state" in hits[0].message
+
+    def test_silent_when_gated_on_legacy_flag(self):
+        assert run_rule(RuleJ002, _J002_FIXED) == []
+
+    def test_silent_when_donation_is_not_optimizer_state(self):
+        assert run_rule(RuleJ002, """
+            import jax
+            from jax.sharding import NamedSharding
+
+            def iteration(u_blocks, i_blocks, users, items):
+                return users, items
+
+            def build(rep):
+                return jax.jit(iteration, donate_argnums=(2, 3),
+                               in_shardings=(rep, rep, rep, rep))
+        """) == []
+
+    def test_silent_in_unsharded_module(self):
+        # no sharded placement -> the legacy miscompile cannot trigger
+        assert run_rule(RuleJ002, """
+            import jax
+
+            def make_train_step():
+                def train_step(params, opt_state):
+                    return params, opt_state
+                return train_step
+
+            step = jax.jit(make_train_step(), donate_argnums=(0, 1))
+        """) == []
+
+    def test_fires_on_decorator_form(self):
+        hits = run_rule(RuleJ002, """
+            import functools
+            import jax
+            from jax.sharding import NamedSharding
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def train_step(params, opt_state):
+                return params, opt_state
+        """)
+        assert [f.rule_id for f in hits] == ["J002"]
+
+
+# -- J003: python control flow on traced values -------------------------------
+
+class TestJ003:
+    def test_fires_on_if_over_jnp_result_in_jit(self):
+        hits = run_rule(RuleJ003, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                s = jnp.sum(x)
+                if s > 0:
+                    return s
+                return -s
+        """)
+        assert [f.rule_id for f in hits] == ["J003"]
+
+    def test_fires_in_pallas_kernel(self):
+        hits = run_rule(RuleJ003, """
+            import jax.numpy as jnp
+            from predictionio_tpu.utils.jax_compat import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                v = x_ref[0]
+                assert v > 0
+                o_ref[0] = v
+
+            def launch(x):
+                return pl.pallas_call(kernel, out_shape=None)(x)
+        """)
+        assert [f.rule_id for f in hits] == ["J003"]
+
+    def test_silent_on_lax_cond_form(self):
+        assert run_rule(RuleJ003, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                s = jnp.sum(x)
+                return jax.lax.cond(s > 0, lambda: s, lambda: -s)
+        """) == []
+
+    def test_silent_on_static_tests(self):
+        # is-None identity, len(), and .shape are static at trace time
+        assert run_rule(RuleJ003, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, mask=None):
+                if mask is None:
+                    mask = jnp.ones(x.shape[:1])
+                if x.shape[0] > 1:
+                    x = x * 2
+                outs = [x, x]
+                if len(outs) == 1:
+                    return outs[0]
+                return x * jnp.sum(mask)
+        """) == []
+
+    def test_silent_outside_jit(self):
+        assert run_rule(RuleJ003, """
+            import jax.numpy as jnp
+
+            def f(x):
+                s = jnp.sum(x)
+                if s > 0:
+                    return s
+                return -s
+        """) == []
+
+    def test_static_argnames_excluded_from_taint(self):
+        assert run_rule(RuleJ003, """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode):
+                if mode == "fast":
+                    return x * 2
+                return x
+        """) == []
+
+
+# -- J004: host sync inside jit -----------------------------------------------
+
+class TestJ004:
+    def test_fires_on_item_float_asarray(self):
+        hits = run_rule(RuleJ004, """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                s = jnp.sum(x)
+                a = s.item()
+                b = float(s)
+                c = np.asarray(s)
+                return a + b + c[0]
+        """)
+        assert [f.rule_id for f in hits] == ["J004"] * 3
+
+    def test_silent_on_host_side_conversion(self):
+        assert run_rule(RuleJ004, """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return jnp.sum(x)
+
+            def serve(x):
+                return float(f(x))
+        """) == []
+
+    def test_silent_on_static_shape_cast(self):
+        assert run_rule(RuleJ004, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                scale = float(x.shape[0])
+                return x / scale
+        """) == []
+
+
+# -- J005: concat-then-reshard to the model axis ------------------------------
+
+class TestJ005:
+    def test_fires_on_concat_resharded_to_model(self):
+        hits = run_rule(RuleJ005, """
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def assemble(outs, mesh):
+                fsh = NamedSharding(mesh, P("model"))
+                full = jnp.concatenate(outs, axis=0)
+                return jax.lax.with_sharding_constraint(full, fsh)
+        """)
+        assert [f.rule_id for f in hits] == ["J005"]
+
+    def test_fires_on_inline_concat_device_put(self):
+        hits = run_rule(RuleJ005, """
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def assemble(outs, mesh):
+                return jax.device_put(
+                    jnp.concatenate(outs), NamedSharding(mesh, P(None, "model"))
+                )
+        """)
+        assert [f.rule_id for f in hits] == ["J005"]
+
+    def test_silent_on_dynamic_update_slice_assembly(self):
+        # the PR-4 fix shape: piecewise updates into a pre-sharded buffer
+        assert run_rule(RuleJ005, """
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def assemble(outs, mesh):
+                fsh = NamedSharding(mesh, P("model"))
+                total = sum(o.shape[0] for o in outs)
+                buf = jax.lax.with_sharding_constraint(
+                    jnp.zeros((total, outs[0].shape[1])), fsh
+                )
+                off = 0
+                for o in outs:
+                    piece = jax.lax.with_sharding_constraint(o, fsh)
+                    buf = jax.lax.dynamic_update_slice(buf, piece, (off, 0))
+                    off += o.shape[0]
+                return buf
+        """) == []
+
+    def test_silent_on_concat_to_data_axis(self):
+        assert run_rule(RuleJ005, """
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def assemble(outs, mesh):
+                row = NamedSharding(mesh, P("data"))
+                return jax.device_put(jnp.concatenate(outs), row)
+        """) == []
+
+
+# -- C001: lock-order cycles --------------------------------------------------
+
+_C001_BUG = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+class TestC001:
+    def test_fires_on_ab_ba_cycle(self):
+        hits = run_rule(RuleC001, _C001_BUG)
+        assert [f.rule_id for f in hits] == ["C001"]
+        assert "_a" in hits[0].message and "_b" in hits[0].message
+
+    def test_silent_on_consistent_order(self):
+        assert run_rule(RuleC001, _C001_BUG.replace(
+            "with self._b:\n                with self._a:",
+            "with self._a:\n                with self._b:",
+        )) == []
+
+    def test_fires_through_one_call_level(self):
+        hits = run_rule(RuleC001, """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def outer(self):
+                    with self._a:
+                        self._inner()
+
+                def _inner(self):
+                    with self._b:
+                        pass
+
+                def reverse(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        assert [f.rule_id for f in hits] == ["C001"]
+
+
+# -- C002: blocking I/O under a lock ------------------------------------------
+
+class TestC002:
+    def test_fires_on_fsync_under_lock(self):
+        hits = run_rule(RuleC002, """
+            import os
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def sync(self, f):
+                    with self._lock:
+                        f.flush()
+                        os.fsync(f.fileno())
+        """)
+        assert [f.rule_id for f in hits] == ["C002"]
+        assert "os.fsync" in hits[0].message
+
+    def test_silent_when_fsync_moved_out(self):
+        assert run_rule(RuleC002, """
+            import os
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def sync(self, f):
+                    with self._lock:
+                        f.flush()
+                        fd = os.dup(f.fileno())
+                    os.fsync(fd)
+                    os.close(fd)
+        """) == []
+
+    def test_fires_on_blocking_queue_put_and_sql_under_lock(self):
+        hits = run_rule(RuleC002, """
+            import threading
+
+            class S:
+                def __init__(self, conn):
+                    self._lock = threading.Lock()
+                    self._queue = __import__("queue").Queue(8)
+                    self._conn = conn
+
+                def a(self, item):
+                    with self._lock:
+                        self._queue.put(item)
+
+                def b(self, sql):
+                    with self._lock:
+                        self._conn.execute(sql)
+        """)
+        assert sorted(f.symbol for f in hits) == ["S.a", "S.b"]
+
+    def test_silent_on_nonblocking_queue_ops(self):
+        assert run_rule(RuleC002, """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._queue = __import__("queue").Queue(8)
+
+                def a(self, item):
+                    with self._lock:
+                        self._queue.put_nowait(item)
+
+                def b(self, item):
+                    with self._lock:
+                        self._queue.put(item, timeout=0.5)
+        """) == []
+
+
+# -- C003: unlocked cross-thread mutation -------------------------------------
+
+_C003_PATH = "predictionio_tpu/data/ingest.py"
+
+_C003_BUG = """
+    import threading
+
+    class P:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            while True:
+                self.count += 1
+
+        def submit(self, n):
+            self.count = n
+"""
+
+
+class TestC003:
+    def test_fires_on_unlocked_shared_counter(self):
+        hits = run_rule(RuleC003, _C003_BUG, path=_C003_PATH)
+        assert [f.rule_id for f in hits] == ["C003"]
+        assert "'count'" in hits[0].message
+
+    def test_silent_with_common_lock(self):
+        fixed = _C003_BUG.replace(
+            "            while True:\n                self.count += 1",
+            "            while True:\n                with self._lock:\n"
+            "                    self.count += 1",
+        ).replace(
+            "        def submit(self, n):\n            self.count = n",
+            "        def submit(self, n):\n            with self._lock:\n"
+            "                self.count = n",
+        )
+        assert run_rule(RuleC003, fixed, path=_C003_PATH) == []
+
+    def test_silent_when_single_thread_mutates(self):
+        single = _C003_BUG.replace(
+            "        def submit(self, n):\n            self.count = n",
+            "        def submit(self, n):\n            return self.count",
+        )
+        assert run_rule(RuleC003, single, path=_C003_PATH) == []
+
+    def test_out_of_scope_module_ignored(self):
+        assert run_rule(
+            RuleC003, _C003_BUG, path="predictionio_tpu/tools/cli.py"
+        ) == []
+
+    def test_fires_through_helper_call(self):
+        helper = """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self._bump()
+
+                def _bump(self):
+                    self.count += 1
+
+                def submit(self, n):
+                    self.count = n
+        """
+        hits = run_rule(RuleC003, helper, path=_C003_PATH)
+        assert [f.rule_id for f in hits] == ["C003"]
+
+
+# -- lockwatch: runtime C001 --------------------------------------------------
+
+class TestLockwatch:
+    def test_seeded_inversion_across_two_threads_detected(self):
+        watch = lockwatch.LockWatch()
+        a = watch.wrap(threading.Lock(), "mod.py:10")
+        b = watch.wrap(threading.Lock(), "mod.py:11")
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        def order_ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=order_ab)
+        t1.start(); t1.join()
+        assert watch.inversions == []
+        t2 = threading.Thread(target=order_ba)
+        t2.start(); t2.join()
+        assert len(watch.inversions) == 1
+        inv = watch.inversions[0]
+        assert set(inv.first) == {"mod.py:10", "mod.py:11"}
+
+    def test_consistent_order_and_reentrancy_stay_clean(self):
+        watch = lockwatch.LockWatch()
+        a = watch.wrap(threading.RLock(), "mod.py:20")
+        b = watch.wrap(threading.Lock(), "mod.py:21")
+        for _ in range(3):
+            with a:
+                with a:          # reentrant re-acquire: no self-edge
+                    with b:
+                        pass
+        assert watch.inversions == []
+        assert ("mod.py:20", "mod.py:21") in watch.edges
+
+    def test_install_wraps_package_locks_only(self):
+        import queue
+
+        was_installed = lockwatch.installed()
+        lockwatch.install()
+        try:
+            from predictionio_tpu.utils.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()   # lock created in package code
+            assert isinstance(registry._lock, lockwatch._WatchedLock)
+            q = queue.Queue()              # stdlib-created lock: untouched
+            assert not isinstance(q.mutex, lockwatch._WatchedLock)
+            registry.inc("x_total")        # watched lock works end-to-end
+            assert "x_total" in registry.exposition()
+        finally:
+            if not was_installed:
+                lockwatch.uninstall()
+
+
+# -- baseline + repo gate -----------------------------------------------------
+
+class TestBaseline:
+    def test_baseline_suppresses_and_reports_stale(self):
+        f = Finding("C002", "warning", "pkg/a.py", 3, "A.m", "msg")
+        entries = [
+            {"rule": "C002", "path": "pkg/a.py", "symbol": "A.m",
+             "justification": "accepted"},
+            {"rule": "J001", "path": "pkg/gone.py", "symbol": "<module>",
+             "justification": "fixed long ago"},
+        ]
+        unsuppressed, suppressed, stale = apply_baseline([f], entries)
+        assert unsuppressed == [] and suppressed == [f]
+        assert [e["path"] for e in stale] == ["pkg/gone.py"]
+
+    def test_committed_baseline_entries_all_justified(self):
+        for entry in load_baseline():
+            just = entry["justification"].strip()
+            assert just and not just.startswith("TODO"), entry
+
+    def test_self_check_clean(self):
+        assert self_check() == []
+
+    def test_self_check_cli_entrypoint(self, capsys):
+        # the `python -m predictionio_tpu.analysis --self-check` surface
+        from predictionio_tpu.analysis.engine import run_cli
+
+        assert run_cli(["--self-check"]) == 0
+        assert "self-check OK" in capsys.readouterr().out
+
+    def test_self_check_rejects_todo_and_stale_entries(self, tmp_path):
+        stale = tmp_path / "baseline.json"
+        stale.write_text(json.dumps({"version": 1, "entries": [
+            {"rule": "J001", "path": "predictionio_tpu/nope.py",
+             "symbol": "<module>", "justification": "TODO: justify or fix"},
+        ]}))
+        problems = self_check(str(stale))
+        assert any("stale" in p for p in problems)
+        assert any("justification" in p for p in problems)
+
+
+def test_repo_wide_zero_unsuppressed_findings():
+    """THE tier-1 gate: every rule over the whole package, committed
+    baseline applied, zero unsuppressed findings, no stale suppressions --
+    and the sweep stays inside the 2-core time budget."""
+    t0 = time.monotonic()
+    findings = check_paths()
+    elapsed = time.monotonic() - t0
+    unsuppressed, _, stale = apply_baseline(findings, load_baseline())
+    assert unsuppressed == [], "\n".join(f.render() for f in unsuppressed)
+    assert stale == [], f"stale baseline entries: {stale}"
+    assert elapsed < 10.0, f"pio check took {elapsed:.1f}s (budget 10s)"
+
+
+def test_cli_check_json(capsys):
+    from predictionio_tpu.tools.cli import main
+
+    rc = main(["check", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["analysis_findings_total"] == 0
+    assert doc["findings"] == [] and doc["stale_baseline"] == []
+    assert len(doc["suppressed"]) >= 1  # the committed accepted findings
+
+
+def test_update_baseline_scoped_run_preserves_out_of_scope_entries(tmp_path):
+    """A --rules/path-scoped --update-baseline must carry over the entries
+    it did not re-examine (and their justifications) verbatim."""
+    import shutil
+
+    from predictionio_tpu.analysis.engine import (
+        default_baseline_path,
+        run_cli,
+    )
+
+    scratch = tmp_path / "baseline.json"
+    shutil.copy(default_baseline_path(), scratch)
+    before = load_baseline(str(scratch))
+    # workflow/ has no findings and no baseline entries: nothing in scope
+    rc = run_cli([
+        "predictionio_tpu/workflow", "--update-baseline",
+        "--baseline", str(scratch),
+    ])
+    assert rc == 0
+    assert load_baseline(str(scratch)) == before
+    # a rule-scoped run likewise leaves the other rules' entries alone
+    rc = run_cli(["--rules", "J001", "--update-baseline", "--baseline", str(scratch)])
+    assert rc == 0
+    assert load_baseline(str(scratch)) == before
+
+
+def test_cli_rejects_bad_paths_and_none_update(capsys):
+    from predictionio_tpu.analysis.engine import run_cli
+
+    assert run_cli(["predictionio_tpu/nonexistent.py"]) == 2
+    assert run_cli(["--baseline", "none", "--update-baseline"]) == 2
+    out = capsys.readouterr().out
+    assert "no such file" in out and "--update-baseline" in out

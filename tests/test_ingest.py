@@ -346,6 +346,47 @@ class TestWalServer:
             wal.close()
 
 
+def test_ack_waits_for_fsync_despite_lock_free_sync(storage_env, tmp_path, monkeypatch):
+    """Regression for the C002 fix (fsync moved outside the WAL writer
+    lock): the group-commit ack ordering is preserved -- a submit's future
+    must not resolve until the WAL fsync for its batch completes, and acks
+    still arrive in submit order."""
+    import os as _os
+
+    l_events = storage_env.get_l_events()
+    l_events.init_channel(1)
+    in_fsync = threading.Event()
+    release = threading.Event()
+    real_fsync = _os.fsync
+
+    def gated_fsync(fd):
+        in_fsync.set()
+        assert release.wait(timeout=10)
+        return real_fsync(fd)
+
+    monkeypatch.setattr(_os, "fsync", gated_fsync)
+    wal = WriteAheadLog(str(tmp_path / "wal"), fsync_policy="always")
+    pipe = IngestPipeline(wal, group_commit_ms=5.0).start()
+    try:
+        # pre-assigned ids so the futures' results are comparable directly
+        events = [_mk_event(i).with_id() for i in range(4)]
+        futures = [pipe.submit(ev, 1, None) for ev in events]
+        assert in_fsync.wait(timeout=5)
+        time.sleep(0.05)
+        # durability gate still closed: nothing may be acked yet
+        assert not any(f.done() for f in futures)
+        release.set()
+        ids = [f.result(timeout=10) for f in futures]
+        # each ack resolves to ITS event's id, in submit order
+        assert ids == [ev.event_id for ev in events]
+        assert len(set(ids)) == 4
+    finally:
+        release.set()
+        monkeypatch.undo()
+        pipe.stop()
+        wal.close()
+
+
 # -- crash-replay integration -------------------------------------------------
 
 def test_crash_replay_exactly_once(tmp_path):
