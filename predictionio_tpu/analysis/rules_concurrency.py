@@ -168,6 +168,21 @@ def _blocking_reason(call: ast.Call) -> str | None:
         attr = call.func.attr
         if attr == "fsync":
             return "fsync"
+        # span/trace export under a lock serializes every instrumented hot
+        # path behind the exporter's I/O -- the classic tracing-overhead
+        # incident shape (obs/ policy: ring-buffer under the lock, any
+        # export/flush outside it). `.export()`/`.force_flush()` are the
+        # OTel exporter verbs; a bare `.flush()` only counts on receivers
+        # that look like tracing objects, so file/stream flushes stay
+        # un-flagged.
+        if attr in ("export", "export_spans", "force_flush"):
+            return f"span export .{attr}()"
+        if attr == "flush":
+            recv = (dotted(call.func.value) or "").lower()
+            if any(
+                s in recv for s in ("trace", "span", "exporter", "telemetry")
+            ):
+                return f"span export .{attr}()"
         if attr in ("execute", "executemany", "commit", "rollback"):
             return f"SQL .{attr}()"
         if attr in ("connect", "sendall", "recv", "accept", "makefile"):

@@ -118,6 +118,9 @@ class EventService:
         stats: bool = False,
         plugins: list[EventServerPlugin] | None = None,
         ingest_config: IngestConfig | None = None,
+        tracing: bool | None = None,
+        trace_sample: float | None = None,
+        slow_commit_ms: float | None = None,
     ):
         self.stats_enabled = stats
         self.stats = _Stats()
@@ -125,8 +128,14 @@ class EventService:
         self.ingest: IngestPipeline | None = None
         self._wal: WriteAheadLog | None = None
         self.router, self.metrics = instrumented_router(
-            before_scrape=self._before_scrape
+            before_scrape=self._before_scrape, tracing=tracing,
+            trace_sample=trace_sample,
         )
+        if slow_commit_ms is not None:
+            # one summary line per group commit over the threshold
+            self.router.tracer.set_slow_threshold(
+                "ingest.commit", slow_commit_ms / 1000.0
+            )
         if ingest_config is not None and ingest_config.mode == "wal":
             self._start_ingest(ingest_config)
         r = self.router
@@ -149,7 +158,9 @@ class EventService:
             segment_bytes=config.segment_bytes,
             fsync_policy=config.fsync_policy,
         )
-        replayed = replay_wal_into_storage(self._wal)
+        replayed = replay_wal_into_storage(
+            self._wal, tracer=self.router.tracer
+        )
         if replayed:
             logging.getLogger("pio.ingest").warning(
                 "replayed %d WAL record(s) into the event store", replayed
@@ -160,6 +171,7 @@ class EventService:
             group_commit_ms=config.group_commit_ms,
             max_batch=config.max_batch,
             metrics=self.metrics,
+            tracer=self.router.tracer,
         ).start()
 
     def shutdown_ingest(self) -> None:
@@ -182,6 +194,21 @@ class EventService:
                 "pio_ingest_queue_depth",
                 float(self.ingest.depth()),
                 help="Events parked in the ingest queue awaiting group commit",
+            )
+        wal = self._wal
+        if wal is not None:
+            registry.set_counter(
+                "pio_wal_appends_total", float(wal.append_count),
+                help="Records framed into the WAL",
+            )
+            registry.set_counter(
+                "pio_wal_fsyncs_total", float(wal.fsync_count),
+                help="WAL fsync calls (one per group commit under policy"
+                " 'always')",
+            )
+            registry.set_gauge(
+                "pio_wal_last_fsync_seconds", wal.last_fsync_s,
+                help="Duration of the most recent WAL fsync",
             )
 
     # -- auth ---------------------------------------------------------------
@@ -238,15 +265,8 @@ class EventService:
         """Validate + authorize + run input blockers on the request thread;
         returns the Event, or the (status, body) rejection."""
         try:
-            if isinstance(obj, dict):
-                # creationTime is server-assigned on the ingest path; a client
-                # (unlike pio import) may not spoof it
-                obj = {k: v for k, v in obj.items() if k != "creationTime"}
-            event = Event.from_json_obj(obj)
-            self._check_event_allowed(record, event.event)
-            for plugin in self.plugins:
-                plugin.input_blocker(event, record.app_id, channel_id)
-            return event
+            with self.router.tracer.span("ingest.parse"):
+                return self._prepare_inner(obj, record, channel_id)
         except EventValidationError as exc:
             if self.stats_enabled:
                 name = obj.get("event", "<invalid>") if isinstance(obj, dict) else "<invalid>"
@@ -261,6 +281,19 @@ class EventService:
             if self.stats_enabled and isinstance(obj, dict):
                 self.stats.record(record.app_id, str(obj.get("event")), exc.status)
             return exc.status, {"message": str(exc)}
+
+    def _prepare_inner(
+        self, obj: Any, record: AccessKey, channel_id: int | None
+    ) -> Event:
+        if isinstance(obj, dict):
+            # creationTime is server-assigned on the ingest path; a client
+            # (unlike pio import) may not spoof it
+            obj = {k: v for k, v in obj.items() if k != "creationTime"}
+        event = Event.from_json_obj(obj)
+        self._check_event_allowed(record, event.event)
+        for plugin in self.plugins:
+            plugin.input_blocker(event, record.app_id, channel_id)
+        return event
 
     def _ack(
         self, event: Event, record: AccessKey, channel_id: int | None, event_id: str
@@ -284,17 +317,14 @@ class EventService:
         mode: submit ALL of them before waiting, so a batch request rides a
         single group commit; a full queue yields per-item 429s."""
         if self.ingest is None:
-            return [
-                self._ack(
-                    ev,
-                    record,
-                    channel_id,
-                    storage_registry.get_l_events().insert(
+            out = []
+            for ev in events:
+                with self.router.tracer.span("storage.insert"):
+                    event_id = storage_registry.get_l_events().insert(
                         ev, record.app_id, channel_id
-                    ),
-                )
-                for ev in events
-            ]
+                    )
+                out.append(self._ack(ev, record, channel_id, event_id))
+            return out
         submitted: list[Any] = []
         for ev in events:
             try:
@@ -510,8 +540,15 @@ def create_event_server(
     stats: bool = False,
     plugins: list[EventServerPlugin] | None = None,
     ingest_config: IngestConfig | None = None,
+    tracing: bool | None = None,
+    trace_sample: float | None = None,
+    slow_commit_ms: float | None = None,
 ) -> ServiceThread:
-    service = EventService(stats=stats, plugins=plugins, ingest_config=ingest_config)
+    service = EventService(
+        stats=stats, plugins=plugins, ingest_config=ingest_config,
+        tracing=tracing, trace_sample=trace_sample,
+        slow_commit_ms=slow_commit_ms,
+    )
     server = make_server(service.router, host, port, "pio-eventserver")
     # drain the group-commit queue on stop: every acknowledged event reaches
     # the WAL and the store before the thread reports stopped
@@ -526,9 +563,16 @@ def run_event_server(
     ssl_key: str | None = None,
     plugins: list[EventServerPlugin] | None = None,
     ingest_config: IngestConfig | None = None,
+    tracing: bool | None = None,
+    trace_sample: float | None = None,
+    slow_commit_ms: float | None = None,
 ) -> None:
     """Blocking entry point used by ``pio eventserver``."""
-    service = EventService(stats=stats, plugins=plugins, ingest_config=ingest_config)
+    service = EventService(
+        stats=stats, plugins=plugins, ingest_config=ingest_config,
+        tracing=tracing, trace_sample=trace_sample,
+        slow_commit_ms=slow_commit_ms,
+    )
     server = make_server(
         service.router, host, port, "pio-eventserver",
         ssl_cert=ssl_cert, ssl_key=ssl_key,

@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.wal import WriteAheadLog
+from predictionio_tpu.obs.trace import NULL_TRACER, current_context
 
 logger = logging.getLogger("pio.ingest")
 
@@ -80,18 +81,26 @@ class _Pending:
     app_id: int
     channel_id: int | None
     future: Future = field(default_factory=Future)
+    #: (trace_id, span_id) of the submitting request, for span fan-out
+    trace_ctx: tuple | None = None
+    submitted: float = field(default_factory=time.perf_counter)
 
 
-def _wal_payload(event: Event, app_id: int, channel_id: int | None) -> bytes:
-    return json.dumps(
-        {"e": event.to_json_obj(), "a": app_id, "c": channel_id},
-        separators=(",", ":"),
-    ).encode("utf-8")
+def _wal_payload(
+    event: Event, app_id: int, channel_id: int | None,
+    trace_id: str | None = None,
+) -> bytes:
+    obj = {"e": event.to_json_obj(), "a": app_id, "c": channel_id}
+    if trace_id:
+        # the trace rides the durable record: a post-crash replay can
+        # attach its span to the ORIGINAL ingest trace
+        obj["t"] = trace_id
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
 
 
-def _wal_parse(payload: bytes) -> tuple[Event, int, int | None]:
+def _wal_parse(payload: bytes) -> tuple[Event, int, int | None, str | None]:
     obj = json.loads(payload.decode("utf-8"))
-    return Event.from_json_obj(obj["e"]), obj["a"], obj["c"]
+    return Event.from_json_obj(obj["e"]), obj["a"], obj["c"], obj.get("t")
 
 
 class IngestPipeline:
@@ -111,6 +120,7 @@ class IngestPipeline:
         group_commit_ms: float = 5.0,
         max_batch: int = 256,
         metrics=None,
+        tracer=None,
     ):
         if l_events is None:
             from predictionio_tpu.data import storage as storage_registry
@@ -118,6 +128,7 @@ class IngestPipeline:
             l_events = storage_registry.get_l_events
         self.wal = wal
         self._l_events = l_events
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self._queue: queue.Queue[_Pending] = queue.Queue(maxsize=queue_size)
         self.group_commit_s = group_commit_ms / 1000.0
         self.max_batch = max_batch
@@ -161,6 +172,8 @@ class IngestPipeline:
         pending = _Pending(
             event if event.event_id else event.with_id(), app_id, channel_id
         )
+        if self.tracer.enabled:
+            pending.trace_ctx = current_context()
         with self._submit_gate:
             if self._stopping.is_set():
                 raise IngestOverload(self.retry_after_s)
@@ -234,23 +247,48 @@ class IngestPipeline:
                 self.wal.checkpoint(last_seqno)
 
     def _commit(self, batch: list[_Pending]) -> None:
+        # the writer thread's own root span: every group commit is one
+        # trace (op "ingest.commit" -- the --slow-commit-ms target), and
+        # its WAL/storage stages fan out to each request's trace too
+        with self.tracer.span(
+            "ingest.commit", attrs={"batch_size": len(batch)}
+        ) as commit_span:
+            self._commit_traced(batch, commit_span)
+
+    def _commit_traced(self, batch: list[_Pending], commit_span) -> None:
         t0 = time.perf_counter()
         last_seqno = None
         if self.wal is not None:
             for p in batch:
                 last_seqno = self.wal.append(
-                    _wal_payload(p.event, p.app_id, p.channel_id)
+                    _wal_payload(
+                        p.event, p.app_id, p.channel_id,
+                        p.trace_ctx[0] if p.trace_ctx else None,
+                    )
                 )
+            sync0 = time.perf_counter()
             self.wal.sync()
+            sync1 = time.perf_counter()
+            # span-list refs captured while the request roots are still
+            # guaranteed open (their threads are parked on the futures);
+            # the fan-out itself runs only after every ack below
+            traced = [
+                (p.trace_ctx, p.submitted,
+                 self.tracer.live_spans(p.trace_ctx[0]))
+                for p in batch if p.trace_ctx is not None
+            ] if self.tracer.enabled else []
             # ack at the durability point: the WAL holds the records even if
             # the storage flush below fails or the process dies
             for p in batch:
                 p.future.set_result(p.event.event_id)
+            self._trace_fanout(traced, len(batch), t0, sync0, sync1,
+                               commit_span)
         items = [(p.event, p.app_id, p.channel_id) for p in batch]
         if self.wal is None:
             # no durability layer: ack only after the store has the events,
             # and surface flush errors to the parked request threads
-            self._l_events().insert_batch(items)
+            with self.tracer.span("storage.flush", attrs={"events": len(items)}):
+                self._l_events().insert_batch(items)
             for p in batch:
                 p.future.set_result(p.event.event_id)
             self._observe(batch, time.perf_counter() - t0)
@@ -268,11 +306,47 @@ class IngestPipeline:
                 # that already exists dedupes alone instead of aborting the
                 # whole multi-tenant transaction (and it makes crash replay
                 # and client retries idempotent).
-                self._l_events().insert_batch(items, on_duplicate="ignore")
+                with self.tracer.span(
+                    "storage.flush", attrs={"events": len(items)}
+                ):
+                    self._l_events().insert_batch(items, on_duplicate="ignore")
                 self.wal.checkpoint(last_seqno)
             except Exception as exc:
                 self._park(items, last_seqno, repr(exc))
         self._observe(batch, time.perf_counter() - t0)
+
+    def _trace_fanout(
+        self, traced: list, n_records: int, t0: float, sync0: float,
+        sync1: float, commit_span,
+    ) -> None:
+        """Record per-request queue-wait plus SHARED wal.append/wal.fsync
+        spans (one span id across the whole batch) into every traced
+        request's trace, and the same stages into the writer's commit
+        trace. Runs AFTER the durability acks (tracing must never delay
+        an ack; the span lists in ``traced`` were captured while the
+        roots were still open), and each physical WAL stage bridges into
+        the span histogram exactly once per commit -- not once per
+        coalesced request."""
+        tracer = self.tracer
+        if not tracer.enabled:
+            return
+        try:
+            extra = None
+            if commit_span.trace_id is not None:
+                extra = (commit_span.trace_id, commit_span.span_id,
+                         tracer.live_spans(commit_span.trace_id))
+            tracer.record_fanout(
+                traced,
+                [
+                    ("wal.append", t0, sync0, {"records": n_records}),
+                    ("wal.fsync", sync0, sync1),
+                ],
+                queue_op="ingest.queue_wait",
+                bridge_queue=True,
+                extra=extra,
+            )
+        except Exception:
+            logger.warning("ingest trace recording failed", exc_info=True)
 
     def _park(self, items: list, last_seqno: int, reason: str) -> None:
         self._retry_batches.append((items, last_seqno))
@@ -337,19 +411,27 @@ class IngestPipeline:
 
 
 def replay_wal_into_storage(
-    wal: WriteAheadLog, l_events=None, batch_size: int = 500
+    wal: WriteAheadLog, l_events=None, batch_size: int = 500, tracer=None
 ) -> int:
     """Re-apply every un-checkpointed WAL record to the event store;
     returns the number of records examined. Duplicate records (crash
     between storage flush and checkpoint) are skipped by the store
-    (``on_duplicate="ignore"``), making replay idempotent."""
+    (``on_duplicate="ignore"``), making replay idempotent.
+
+    WAL records carry their originating trace id: with a ``tracer``, each
+    distinct replayed trace gains a ``wal.replay`` span, so the original
+    ingest trace shows its post-crash completion instead of dead-ending
+    at the ack."""
     if l_events is None:
         from predictionio_tpu.data import storage as storage_registry
 
         l_events = storage_registry.get_l_events
+    tracer = tracer if tracer is not None else NULL_TRACER
     count = 0
     last_seqno = 0
     pending: list[tuple[Event, int, int | None]] = []
+    replayed_traces: set[str] = set()
+    t_start = time.perf_counter()
 
     def flush() -> None:
         if pending:
@@ -357,7 +439,10 @@ def replay_wal_into_storage(
             pending.clear()
 
     for seqno, payload in wal.replay():
-        pending.append(_wal_parse(payload))
+        event, app_id, channel_id, trace_id = _wal_parse(payload)
+        pending.append((event, app_id, channel_id))
+        if trace_id and tracer.enabled:
+            replayed_traces.add(trace_id)
         last_seqno = seqno
         count += 1
         if len(pending) >= batch_size:
@@ -365,4 +450,10 @@ def replay_wal_into_storage(
     flush()
     if last_seqno:
         wal.checkpoint(last_seqno)
+    t_end = time.perf_counter()
+    for trace_id in replayed_traces:
+        tracer.record_span(
+            trace_id, "wal.replay", t_start, t_end,
+            attrs={"records_total": count},
+        )
     return count

@@ -123,6 +123,11 @@ class WriteAheadLog:
         self.fsync_interval_s = fsync_interval_ms / 1000.0
         self._lock = threading.Lock()
         self._last_fsync = 0.0
+        #: observability counters (read without the lock: monotonic ints /
+        #: a last-written float, mirrored into /metrics at scrape time)
+        self.append_count = 0
+        self.fsync_count = 0
+        self.last_fsync_s = 0.0
         # collectible segments only appear on rotation (and at startup,
         # where prior-run segments may be replay-covered): gate GC on that
         # instead of paying a directory listing per group commit
@@ -200,6 +205,7 @@ class WriteAheadLog:
             )
             self._file.write(frame)
             self._segment_size += frame_len
+            self.append_count += 1
             return seqno
 
     def sync(self) -> None:
@@ -221,10 +227,13 @@ class WriteAheadLog:
                 if time.monotonic() - self._last_fsync < self.fsync_interval_s:
                     return
             fd = os.dup(self._file.fileno())
+        t0 = time.monotonic()
         try:
             os.fsync(fd)
         finally:
             os.close(fd)
+        self.fsync_count += 1
+        self.last_fsync_s = time.monotonic() - t0
         # only a SUCCESSFUL fsync consumes the interval slot -- if it
         # raised, the caller's retry must actually hit the disk instead of
         # short-circuiting on a pre-advanced timestamp (benign unlocked

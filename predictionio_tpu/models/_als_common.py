@@ -235,9 +235,16 @@ def fit_with_checkpoint(
     mismatch discards the checkpoints and trains fresh with a warning.
 
     ``interval`` <= 0 disables checkpointing entirely.
+
+    With ``pio train --profile`` (runtime conf ``pio.profile``) a per-step
+    telemetry journal (``<profile-dir>/<name>-telemetry.jsonl``: wall
+    time, edges/sec, achieved GB/s against the bytes-moved model,
+    recompile count) is written alongside the ``jax.profiler`` trace the
+    workflow captures -- the cheap always-parseable view vs the deep one.
     """
     config = resolve_factor_sharding(config, mesh)
     config = resolve_solver_override(config, ctx)
+    telemetry = _build_telemetry(ctx, als_data, config, mesh, name)
     checkpoint = ctx.checkpoint_manager(name) if interval > 0 else None
     init, start_iteration, callback = None, 0, None
     if checkpoint is not None:
@@ -279,15 +286,69 @@ def fit_with_checkpoint(
                 it, {"users": users_np, "items": items_np, "iteration": it}
             )
 
-    model = als_fit(
-        als_data,
-        config,
-        mesh,
-        callback=callback,
-        callback_interval=interval,
-        init=init,
-        start_iteration=start_iteration,
-    )
+    from predictionio_tpu.obs.trace import global_tracer
+
+    try:
+        with global_tracer().span(
+            "als.fit", attrs={"name": name, "iterations": config.iterations}
+        ):
+            model = als_fit(
+                als_data,
+                config,
+                mesh,
+                callback=callback,
+                callback_interval=interval,
+                init=init,
+                start_iteration=start_iteration,
+                telemetry=telemetry,
+            )
+    finally:
+        if telemetry is not None:
+            telemetry.close()
     if checkpoint is not None:
         checkpoint.close()
     return model
+
+
+def _build_telemetry(ctx, als_data, config: ALSConfig, mesh, name: str):
+    """A ``TrainTelemetry`` journal when the run is profiled
+    (``pio.profile`` runtime conf), else None (the un-profiled loop must
+    not pay per-step device syncs)."""
+    import os
+
+    profile_dir = (getattr(ctx, "runtime_conf", None) or {}).get("pio.profile")
+    if not profile_dir:
+        return None
+    try:
+        from predictionio_tpu.obs.telemetry import TrainTelemetry
+        from predictionio_tpu.parallel.als import (
+            modeled_bytes_per_iteration,
+            real_edges,
+            resolve_solver,
+        )
+
+        try:
+            platform = mesh.devices.flat[0].platform if mesh is not None else "cpu"
+        except Exception:
+            platform = "cpu"
+        solver = resolve_solver(config.solver, platform)
+        itemsize = 2 if config.dtype == "bfloat16" else 4
+        return TrainTelemetry(
+            os.path.join(str(profile_dir), f"{name}-telemetry.jsonl"),
+            edges=real_edges(als_data),
+            modeled_bytes_per_iter=modeled_bytes_per_iteration(
+                als_data, config.rank, itemsize, fused=solver == "pallas"
+            ),
+            meta={
+                "name": name,
+                "rank": config.rank,
+                "solver": solver,
+                "platform": platform,
+                "dtype": config.dtype,
+                "iterations": config.iterations,
+            },
+        )
+    except Exception:
+        # telemetry must never fail a training run
+        logger.warning("profile telemetry setup failed", exc_info=True)
+        return None

@@ -1,0 +1,29 @@
+"""Observability substrate: tracing, structured logs, training telemetry.
+
+The aggregate Prometheus layer (``utils/metrics``) answers "how is the
+service doing on average"; this package answers "where did THIS request
+spend its 12 ms" and "what is the achieved bandwidth of THIS training
+step" -- the per-operation visibility 1612.01437 shows dominating
+distributed-ML debugging, rebuilt without the Spark UI:
+
+- ``obs.trace``   -- low-overhead span tracer (W3C ``traceparent`` in/out,
+  thread-local context, bounded ring buffers with tail-based keep for
+  slow/error traces); every service exposes ``GET /traces.json``.
+- ``obs.logs``    -- shared log formatters; ``--log-format json`` emits
+  one JSON object per record with ``trace_id``/``span_id`` when a span is
+  active.
+- ``obs.telemetry`` -- per-step training journal (wall time, edges/sec,
+  modeled-bytes achieved GB/s, recompile count) behind
+  ``pio train --profile``.
+- ``obs.top``     -- the ``pio top`` live terminal view over ``/metrics``
+  + ``/traces.json``.
+"""
+
+from predictionio_tpu.obs.trace import (  # noqa: F401
+    NULL_TRACER,
+    Tracer,
+    current_context,
+    format_traceparent,
+    global_tracer,
+    parse_traceparent,
+)

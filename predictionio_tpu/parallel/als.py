@@ -33,6 +33,7 @@ observed pairs, preference p = 1; unobserved pairs have c = 1, p = 0.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -826,6 +827,30 @@ def device_put_blocks(side: BucketedCSR, put) -> tuple:
     )
 
 
+def modeled_bytes_per_iteration(
+    data: ALSData, rank: int, itemsize: int, fused: bool
+) -> float:
+    """HBM bytes one full ALS iteration moves through its half-step tails
+    (``ops.als_gram.half_step_bytes`` summed over both sides' buckets).
+    The half-step is bandwidth-bound, so achieved GB/s against this model
+    is the training-efficiency axis -- the number the ``--profile``
+    telemetry journal and the bench secondary both report."""
+    from predictionio_tpu.ops.als_gram import half_step_bytes
+
+    return sum(
+        half_step_bytes(*block.indices.shape, rank, itemsize, fused)
+        for side in (data.by_row, data.by_col)
+        for block in side.blocks
+    )
+
+
+def real_edges(data: ALSData) -> int:
+    """Real (unpadded) observations -- the edges/sec denominator. Sides
+    built by the sharded reader hold only this process's rows; the count
+    is then per-process, which is the per-chip rate ALX reports."""
+    return int(sum(b.mask.sum() for b in data.by_row.blocks))
+
+
 def als_fit(
     data: ALSData,
     config: ALSConfig,
@@ -834,6 +859,7 @@ def als_fit(
     callback_interval: int = 1,
     init: tuple[np.ndarray, np.ndarray] | None = None,
     start_iteration: int = 0,
+    telemetry=None,
 ) -> ALSModel:
     """Run ALS to convergence budget; returns host-side factor matrices.
 
@@ -847,8 +873,18 @@ def als_fit(
     resume from checkpointed factors (original order): the remaining
     iterations run, which is exact for ALS (each iteration depends only on
     the previous factors). ``mesh`` defaults to a 1-device local mesh.
+
+    ``telemetry`` (``obs.telemetry.TrainTelemetry``) records one journal
+    line per iteration: wall time, edges/sec, achieved GB/s vs the
+    bytes-moved model, recompile count. Per-step timing needs a device
+    sync after EVERY iteration (a one-scalar fetch), which serializes the
+    dispatch pipeline -- that cost is only paid when profiling is on;
+    the un-profiled loop keeps its async chain.
     """
+    from predictionio_tpu.obs.trace import global_tracer
     from predictionio_tpu.parallel.mesh import local_mesh
+
+    tracer = global_tracer()
 
     mesh = mesh or local_mesh(1, 1)
     if config.dtype not in ("float32", "bfloat16"):
@@ -914,8 +950,14 @@ def als_fit(
             for b, rows in zip(side.blocks, side.global_rows)
         )
 
-    u_blocks = put_side(data.by_row)
-    i_blocks = put_side(data.by_col)
+    with tracer.span(
+        "als.transfer",
+        attrs={"edges": data.by_row.retained_edges or None},
+    ):
+        # host->device CSR transfer: the step the device-resident-epochs
+        # ROADMAP item wants to overlap; its span makes the cost visible
+        u_blocks = put_side(data.by_row)
+        i_blocks = put_side(data.by_col)
 
     if config.factor_sharding == "model":
         m = mesh.shape["model"]
@@ -958,10 +1000,35 @@ def als_fit(
         # checkpoints and serving stay dtype-stable across bf16 runs
         return fetch(factors)[side.slot_of].astype(np.float32)
 
+    if telemetry is not None:
+        from predictionio_tpu.obs.telemetry import jit_cache_size
+
+        def step_sync(x) -> None:
+            # one-scalar fetch: a hard device sync even on remote-tunnel
+            # backends where block_until_ready returns early (bench.py
+            # precedent); the donated-buffer chain keeps it honest
+            np.asarray(jax.device_get(x[:1, :1]))
+
     for it in range(start_iteration, config.iterations):
-        user_factors, item_factors = iteration(
-            u_blocks, i_blocks, user_factors, item_factors, reg, alpha
-        )
+        if telemetry is not None:
+            # per-half-step resolution lives inside one jitted program;
+            # the per-iteration span + journal line (wall, edges/sec,
+            # achieved GB/s) is the honest host-visible boundary
+            with tracer.span("als.iteration", attrs={"step": it}):
+                step_t0 = time.perf_counter()
+                user_factors, item_factors = iteration(
+                    u_blocks, i_blocks, user_factors, item_factors, reg, alpha
+                )
+                step_sync(user_factors)
+                telemetry.record_step(
+                    it,
+                    time.perf_counter() - step_t0,
+                    recompile_count=jit_cache_size(iteration),
+                )
+        else:
+            user_factors, item_factors = iteration(
+                u_blocks, i_blocks, user_factors, item_factors, reg, alpha
+            )
         if (
             callback is not None
             and (it + 1) % callback_interval == 0

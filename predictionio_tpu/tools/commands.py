@@ -17,6 +17,7 @@ def register(sub: argparse._SubParsersAction) -> None:
         engine_commands,
         import_export,
         server_commands,
+        top_command,
     )
 
     app_commands.register(sub)
@@ -25,3 +26,4 @@ def register(sub: argparse._SubParsersAction) -> None:
     engine_commands.register(sub)
     import_export.register(sub)
     server_commands.register(sub)
+    top_command.register(sub)

@@ -50,6 +50,20 @@ def register(sub: argparse._SubParsersAction) -> None:
         " auto (pallas on accelerators, xla on CPU). Overrides the"
         " engine.json alsSolver param for this run",
     )
+    train.add_argument(
+        "--profile",
+        nargs="?",
+        const="__default__",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler trace (tensorboard/xprof-loadable) AND"
+        " a per-step telemetry journal (wall time, edges/sec, achieved HBM"
+        " GB/s, recompile count) into DIR (default:"
+        " <engine-dir>/pio-profile)",
+    )
+    from predictionio_tpu.obs.logs import add_logging_arguments
+
+    add_logging_arguments(train)
     train.add_argument("passthrough", nargs="*", help="runtime conf after --")
     train.set_defaults(func=cmd_train)
 
@@ -82,6 +96,23 @@ def register(sub: argparse._SubParsersAction) -> None:
         help="comma-separated padded batch shapes; jitted scorers compile "
         "once per bucket",
     )
+    deploy.add_argument(
+        "--no-tracing", action="store_true",
+        help="disable the span tracer (/traces.json reports enabled=false;"
+        " the off path allocates no spans)",
+    )
+    deploy.add_argument(
+        "--trace-sample", type=float, default=None, metavar="RATE",
+        help="head-sampling rate (0..1) for headerless root traces;"
+        " requests with a traceparent header always trace (default:"
+        " $PIO_TRACE_SAMPLE or 0.125)",
+    )
+    deploy.add_argument(
+        "--slow-query-ms", type=float, default=None, metavar="MS",
+        help="log one span-summary line for any query trace slower than"
+        " this (off by default)",
+    )
+    add_logging_arguments(deploy)
     deploy.set_defaults(func=cmd_deploy)
 
     undeploy = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -132,10 +163,19 @@ def _load_variant(args: argparse.Namespace):
 
 
 def cmd_train(args: argparse.Namespace) -> int:
+    from predictionio_tpu.obs.logs import configure_logging
     from predictionio_tpu.workflow.core_workflow import run_train
 
+    configure_logging(args.log_format)
     variant = _load_variant(args)
     variant.runtime_conf.update(_parse_passthrough(args.passthrough))
+    if args.profile:
+        profile_dir = (
+            os.path.join(args.engine_dir, "pio-profile")
+            if args.profile == "__default__"
+            else args.profile
+        )
+        variant.runtime_conf["pio.profile"] = profile_dir
     # runtime conf reaches components holding a ctx; the env mirrors it for
     # ctx-free layers (PEventStore.dataset) in this same process
     if args.snapshot_mode:
@@ -157,12 +197,14 @@ def cmd_train(args: argparse.Namespace) -> int:
 
 
 def cmd_deploy(args: argparse.Namespace) -> int:
+    from predictionio_tpu.obs.logs import configure_logging
     from predictionio_tpu.workflow.create_server import (
         FeedbackConfig,
         run_query_server,
     )
     from predictionio_tpu.workflow.microbatch import BatchConfig
 
+    configure_logging(args.log_format)
     variant = _load_variant(args)
     feedback = None
     if args.feedback:
@@ -195,6 +237,9 @@ def cmd_deploy(args: argparse.Namespace) -> int:
             window_ms=args.batch_window_ms,
             buckets=buckets,
         ),
+        tracing=False if args.no_tracing else None,
+        trace_sample=args.trace_sample,
+        slow_query_ms=args.slow_query_ms,
     )
     return 0
 

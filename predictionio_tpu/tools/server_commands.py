@@ -37,16 +37,37 @@ def register(sub: argparse._SubParsersAction) -> None:
         "--wal-dir", default=None,
         help="WAL directory (default $PIO_FS_BASEDIR/wal)",
     )
+    es.add_argument(
+        "--no-tracing", action="store_true",
+        help="disable the span tracer (/traces.json reports enabled=false;"
+        " the off path allocates no spans)",
+    )
+    es.add_argument(
+        "--trace-sample", type=float, default=None, metavar="RATE",
+        help="head-sampling rate (0..1) for headerless root traces;"
+        " requests with a traceparent header always trace (default:"
+        " $PIO_TRACE_SAMPLE or 0.125)",
+    )
+    es.add_argument(
+        "--slow-commit-ms", type=float, default=None, metavar="MS",
+        help="log one span-summary line for any group commit slower than"
+        " this (off by default)",
+    )
+    from predictionio_tpu.obs.logs import add_logging_arguments
+
+    add_logging_arguments(es)
     es.set_defaults(func=cmd_eventserver)
 
     db = sub.add_parser("dashboard", help="start the evaluation dashboard")
     db.add_argument("--ip", default="0.0.0.0")
     db.add_argument("--port", type=int, default=9000)
+    add_logging_arguments(db)
     db.set_defaults(func=cmd_dashboard)
 
     admin = sub.add_parser("adminserver", help="start the admin REST server")
     admin.add_argument("--ip", default="0.0.0.0")
     admin.add_argument("--port", type=int, default=7071)
+    add_logging_arguments(admin)
     admin.set_defaults(func=cmd_adminserver)
 
     shell = sub.add_parser("shell", help="interactive console with the runtime preloaded")
@@ -73,7 +94,9 @@ def load_plugins(specs: list[str]) -> list:
 def cmd_eventserver(args: argparse.Namespace) -> int:
     from predictionio_tpu.data.api.eventserver import run_event_server
     from predictionio_tpu.data.ingest import IngestConfig
+    from predictionio_tpu.obs.logs import configure_logging
 
+    configure_logging(args.log_format)
     run_event_server(
         host=args.ip, port=args.port, stats=args.stats,
         ssl_cert=args.ssl_cert, ssl_key=args.ssl_key,
@@ -85,20 +108,27 @@ def cmd_eventserver(args: argparse.Namespace) -> int:
             fsync_policy=args.fsync_policy,
             wal_dir=args.wal_dir,
         ),
+        tracing=False if args.no_tracing else None,
+        trace_sample=args.trace_sample,
+        slow_commit_ms=args.slow_commit_ms,
     )
     return 0
 
 
 def cmd_dashboard(args: argparse.Namespace) -> int:
+    from predictionio_tpu.obs.logs import configure_logging
     from predictionio_tpu.tools.dashboard import run_dashboard
 
+    configure_logging(args.log_format)
     run_dashboard(host=args.ip, port=args.port)
     return 0
 
 
 def cmd_adminserver(args: argparse.Namespace) -> int:
+    from predictionio_tpu.obs.logs import configure_logging
     from predictionio_tpu.tools.adminserver import run_admin_server
 
+    configure_logging(args.log_format)
     run_admin_server(host=args.ip, port=args.port)
     return 0
 

@@ -19,6 +19,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.obs.trace import format_traceparent
+
 
 @dataclass
 class Request:
@@ -67,11 +69,20 @@ class Router:
     records ``pio_http_requests_total{method,route,status}`` and a
     ``pio_http_request_duration_seconds`` histogram, labeled by the ROUTE
     PATTERN (bounded cardinality), not the raw path.
+
+    With a ``tracer`` attached (``obs.trace``), every dispatch runs under
+    a root span named by the route pattern: an inbound W3C ``traceparent``
+    header joins the caller's trace, the response carries ``traceparent``
+    out, error-status JSON bodies gain a ``traceId`` field, and handler
+    exceptions become a 500 WITH the trace id (traceback still printed --
+    the ``make_server`` backstop behavior, moved here so the trace id
+    exists when the response is built).
     """
 
-    def __init__(self, metrics=None):
+    def __init__(self, metrics=None, tracer=None):
         self._routes: list[tuple[str, str, re.Pattern, Handler]] = []
         self.metrics = metrics
+        self.tracer = tracer
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
         regex = re.sub(r"<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", pattern)
@@ -86,7 +97,48 @@ class Router:
 
         return deco
 
+    #: never traced: a scrape loop (Prometheus, `pio top`) would otherwise
+    #: flood the ring buffers with its own polling traffic
+    UNTRACED_PATHS = ("/metrics", "/traces.json")
+
     def dispatch(self, request: Request) -> Response:
+        tracer = self.tracer
+        if (
+            tracer is None
+            or not tracer.enabled
+            or request.path in self.UNTRACED_PATHS
+        ):
+            return self._dispatch(request, None)
+        traceparent = next(
+            (
+                v
+                for k, v in request.headers.items()
+                if k.lower() == "traceparent"
+            ),
+            None,
+        )
+        with tracer.start_remote(
+            f"{request.method} {request.path}", traceparent
+        ) as span:
+            # a sampled-out root (trace_id None) suppresses all span work
+            # for the request; it must also not emit ids it never made
+            sampled = span.trace_id is not None
+            response = self._dispatch(request, span if sampled else None)
+            if sampled:
+                span.set_attr("status", response.status)
+                if response.status >= 500:
+                    span.set_status("error")
+                response.headers.setdefault(
+                    "traceparent",
+                    format_traceparent(span.trace_id, span.span_id),
+                )
+                # error bodies carry the trace id so a client report ("here
+                # is the 429 I got") joins directly to the server-side trace
+                if response.status >= 400 and isinstance(response.body, dict):
+                    response.body.setdefault("traceId", span.trace_id)
+        return response
+
+    def _dispatch(self, request: Request, span) -> Response:
         t0 = time.perf_counter()
         route_label = "<unmatched>"
         path_matched = False
@@ -102,12 +154,21 @@ class Router:
                 continue
             request.path_params = m.groupdict()
             route_label = pattern
+            if span is not None:
+                # route pattern, not raw path: bounded op cardinality
+                span.set_op(f"{request.method} {pattern}")
             try:
                 response = handler(request)
             except json.JSONDecodeError:
                 # same mapping the server backstop applies -- handled here
                 # so the metric records the 400 the client actually gets
                 response = Response(400, {"message": "malformed JSON body"})
+            except Exception:
+                # same backstop contract as make_server (traceback printed,
+                # generic 500), handled here so the active span can stamp
+                # its trace id onto the response
+                traceback.print_exc()
+                response = Response(500, {"message": "internal server error"})
             except BaseException:
                 self._record(request, route_label, 500, t0)
                 raise
@@ -118,6 +179,11 @@ class Router:
                 if path_matched
                 else Response(404, {"message": "not found"})
             )
+            if span is not None:
+                # no handler ran, so the span still carries the raw client
+                # path as its op; rename to the bounded route label or the
+                # span->histogram bridge mints one series per scanner probe
+                span.set_op(f"{request.method} {route_label}")
         self._record(request, route_label, response.status, t0)
         return response
 
@@ -136,25 +202,79 @@ class Router:
         )
 
 
-def instrumented_router(before_scrape=None) -> tuple[Router, "object"]:
+def instrumented_router(
+    before_scrape=None,
+    tracing: bool | None = None,
+    trace_sample: float | None = None,
+) -> tuple[Router, "object"]:
     """(router, registry): a Router wired to a fresh MetricsRegistry with
     the ``GET /metrics`` Prometheus exposition route installed -- the one
-    definition every service (event, query, dashboard, admin) shares.
+    definition every service (event, query, dashboard, admin) shares --
+    plus a span tracer (``router.tracer``) exposing ``GET /traces.json``
+    (recent + slowest + error traces; ``?op=substr&min_ms=N&limit=N``).
 
     ``before_scrape(registry)`` runs on every /metrics request, letting a
     service mirror externally-tracked state (e.g. the query server's
     served-count) into the registry without maintaining it in two places.
+
+    ``tracing`` defaults to on unless ``PIO_TRACING=0``; pass False for
+    an A/B arm or a zero-overhead deployment (the disabled path hands out
+    one shared no-op span and allocates nothing). ``trace_sample``
+    defaults to ``PIO_TRACE_SAMPLE`` (1-in-8): headerless roots -- and
+    ``traceparent`` headers with the W3C sampled flag clear (``-00``) --
+    sample at that rate, while a header with the flag set always traces;
+    pass 1.0 to trace everything.
     """
+    from predictionio_tpu.obs.trace import (
+        Tracer,
+        tracing_enabled_default,
+        tracing_sample_default,
+    )
     from predictionio_tpu.utils.metrics import (
         CONTENT_TYPE,
         MetricsRegistry,
+        build_info_labels,
         global_registry,
+        span_bridge,
     )
 
     registry = MetricsRegistry()
-    router = Router(metrics=registry)
+    if tracing is None:
+        tracing = tracing_enabled_default()
+    if trace_sample is None:
+        trace_sample = tracing_sample_default()
+    router = Router(
+        metrics=registry,
+        tracer=Tracer(
+            enabled=tracing,
+            on_spans=span_bridge(registry),
+            sample=trace_sample,
+        ),
+    )
+    # build-info labels can change exactly once per fact (backend resolves,
+    # jax gets imported); zero out a superseded series so dashboards see
+    # one live build_info row, then freeze once everything is resolved
+    build_state = {"labels": None, "frozen": False}
+
+    def refresh_build_info() -> None:
+        if build_state["frozen"]:
+            return
+        labels = build_info_labels()
+        prev = build_state["labels"]
+        if prev is not None and prev != labels:
+            registry.set_gauge("pio_build_info", 0.0, prev)
+        registry.set_gauge(
+            "pio_build_info", 1.0, labels,
+            help="Build/runtime identity (value is always 1)",
+        )
+        build_state["labels"] = labels
+        build_state["frozen"] = not (
+            "not-imported" in labels.values()
+            or labels.get("backend") == "uninitialized"
+        )
 
     def handle_metrics(request: Request) -> Response:
+        refresh_build_info()
         if before_scrape is not None:
             before_scrape(registry)
         body = registry.exposition()
@@ -165,7 +285,21 @@ def instrumented_router(before_scrape=None) -> tuple[Router, "object"]:
             body = body.rstrip("\n") + "\n" + shared + "\n"
         return Response(200, body, content_type=CONTENT_TYPE)
 
+    def handle_traces(request: Request) -> Response:
+        q = request.query
+        try:
+            min_ms = float(q["min_ms"]) if "min_ms" in q else None
+            limit = int(q.get("limit", 50))
+        except ValueError:
+            return Response(
+                400, {"message": "min_ms must be a number, limit an integer"}
+            )
+        return Response(
+            200, router.tracer.snapshot(op=q.get("op"), min_ms=min_ms, limit=limit)
+        )
+
     router.add("GET", "/metrics", handle_metrics)
+    router.add("GET", "/traces.json", handle_traces)
     return router, registry
 
 

@@ -14,6 +14,7 @@ and expose ``GET /metrics``.
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left
 from typing import Mapping
 
 #: latency buckets (seconds): sub-ms serving up to slow storage calls
@@ -23,6 +24,13 @@ DEFAULT_BUCKETS = (
 )
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: span-duration buckets (seconds): spans start well under the request
+#: histograms (queue waits and WAL appends are tens of microseconds)
+SPAN_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
 
 
 def global_registry() -> "MetricsRegistry":
@@ -119,12 +127,40 @@ class MetricsRegistry:
                 name, (tuple(buckets), {})
             )
             row = series.setdefault(key, [0] * (len(bucket_spec) + 1) + [0.0, 0])
-            for i, le in enumerate(bucket_spec):
-                if value <= le:
-                    row[i] += 1
-            row[len(bucket_spec)] += 1        # +Inf bucket
+            # rows hold PER-BUCKET (non-cumulative) counts: one bisect +
+            # one increment per observation instead of a walk over every
+            # bucket -- observe sits on the span bridge's per-span path.
+            # Exposition folds the running sum back into Prometheus'
+            # cumulative le semantics.
+            row[bisect_left(bucket_spec, value)] += 1
             row[-2] += value                  # sum
             row[-1] += 1                      # count
+
+    def observe_batch(
+        self,
+        name: str,
+        items: "list[tuple[float, tuple]]",
+        buckets: tuple = DEFAULT_BUCKETS,
+        help: str = "",
+    ) -> None:
+        """Fold many ``(value, label_key)`` observations under one lock
+        acquisition; ``label_key`` is the pre-sorted ``(("k", "v"), ...)``
+        series key. The span bridge's path: one call per completed trace
+        instead of one lock round-trip per span."""
+        with self._lock:
+            if help:
+                self._help.setdefault(name, help)
+            bucket_spec, series = self._histograms.setdefault(
+                name, (tuple(buckets), {})
+            )
+            empty = [0] * (len(bucket_spec) + 1) + [0.0, 0]
+            for value, key in items:
+                row = series.get(key)
+                if row is None:
+                    row = series[key] = empty[:]
+                row[bisect_left(bucket_spec, value)] += 1
+                row[-2] += value              # sum
+                row[-1] += 1                  # count
 
     def exposition(self) -> str:
         lines: list[str] = []
@@ -149,17 +185,20 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {name} histogram")
                 for key, row in sorted(series.items()):
                     labels = dict(key)
-                    # rows store per-bucket CUMULATIVE counts already
-                    # (observe increments every bucket with value <= le)
+                    # rows store per-bucket counts; Prometheus buckets are
+                    # cumulative, so fold the running sum here (scrape
+                    # rate), not in observe (span rate)
+                    cumulative = 0
                     for i, le in enumerate(buckets):
+                        cumulative += row[i]
                         lines.append(
                             f"{name}_bucket"
                             f"{_fmt_labels({**labels, 'le': f'{le:g}'})}"
-                            f" {row[i]}"
+                            f" {cumulative}"
                         )
                     lines.append(
                         f"{name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})}"
-                        f" {row[len(buckets)]}"
+                        f" {cumulative + row[len(buckets)]}"
                     )
                     lines.append(f"{name}_sum{_fmt_labels(labels)} {row[-2]:.17g}")
                     lines.append(f"{name}_count{_fmt_labels(labels)} {row[-1]}")
@@ -167,3 +206,72 @@ class MetricsRegistry:
 
 
 _GLOBAL_REGISTRY = MetricsRegistry()
+
+
+def span_bridge(registry: MetricsRegistry):
+    """Span -> histogram bridge: the batch hook (``obs.trace.Tracer
+    (on_spans=...)``) that folds finished spans into
+    ``pio_span_duration_seconds{op}``, so the aggregate view of the
+    traced stages exists without a second instrumentation layer. Takes a
+    LIST (one completed trace, or standalone records) and folds it under
+    ONE registry lock acquisition -- per-span locking convoyed the
+    serving tier's handler threads. Op cardinality is bounded by
+    construction (route patterns + a fixed set of stage names)."""
+
+    def observe(records) -> None:
+        registry.observe_batch(
+            "pio_span_duration_seconds",
+            [(r.duration_s, (("op", r.op),)) for r in records],
+            buckets=SPAN_BUCKETS,
+            help="Span durations by operation (tracing bridge)",
+        )
+        for r in records:
+            if r.status == "error":
+                registry.inc(
+                    "pio_span_errors_total",
+                    {"op": r.op},
+                    help="Spans finished in error status",
+                )
+
+    return observe
+
+
+def build_info_labels() -> dict[str, str]:
+    """Labels for the ``pio_build_info`` gauge: package version, jax
+    version, EFFECTIVE backend, and the ``IS_LEGACY_JAX`` drift-shim
+    state -- the four facts a dashboard or bug report needs to correlate
+    behavior with the runtime actually underneath.
+
+    Never initializes jax (a ``/metrics`` scrape must not wedge a
+    storage-only service on a dead accelerator tunnel): if jax is not
+    imported the backend reports ``not-imported``; if imported but no
+    backend has been resolved yet it reports ``uninitialized``.
+    """
+    import sys
+
+    from predictionio_tpu.version import __version__
+
+    labels = {"version": __version__}
+    jaxmod = sys.modules.get("jax")
+    if jaxmod is None:
+        labels["jax_version"] = "not-imported"
+        labels["backend"] = "not-imported"
+        labels["legacy_jax"] = "unknown"
+        return labels
+    labels["jax_version"] = getattr(jaxmod, "__version__", "unknown")
+    try:
+        from predictionio_tpu.utils.jax_compat import IS_LEGACY_JAX
+
+        labels["legacy_jax"] = "true" if IS_LEGACY_JAX else "false"
+    except Exception:
+        labels["legacy_jax"] = "unknown"
+    backend = None
+    try:
+        # read the already-resolved backend without triggering resolution
+        xla_bridge = jaxmod._src.xla_bridge
+        resolved = getattr(xla_bridge, "_default_backend", None)
+        backend = getattr(resolved, "platform", None)
+    except Exception:
+        backend = None
+    labels["backend"] = backend or "uninitialized"
+    return labels

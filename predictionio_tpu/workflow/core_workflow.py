@@ -205,23 +205,37 @@ def _run_train_locked(
         resume=resume,
     )
     profile_dir = variant.runtime_conf.get("pio.profile")
+    from predictionio_tpu.obs.trace import global_tracer
+
+    tracer = global_tracer()
     try:
         if profile_dir:
             # jax profiler trace (xplane, viewable in tensorboard/xprof) --
-            # the Spark-UI replacement for training observability
+            # the Spark-UI replacement for training observability; the
+            # per-step telemetry journal (obs.telemetry) lands in the same
+            # directory via the algorithms' fit_with_checkpoint hook
             import jax
 
+            os.makedirs(str(profile_dir), exist_ok=True)
             trace_ctx = jax.profiler.trace(str(profile_dir))
         else:
             import contextlib
 
             trace_ctx = contextlib.nullcontext()
         with trace_ctx:
-            models = engine.train(
-                ctx, engine_params, skip_sanity_check=workflow_params.skip_sanity_check
+            with tracer.span(
+                "train.run",
+                attrs={"instance": instance_id, "engine": variant.variant_id},
+            ):
+                models = engine.train(
+                    ctx, engine_params,
+                    skip_sanity_check=workflow_params.skip_sanity_check,
+                )
+        with tracer.span("train.persist", attrs={"instance": instance_id}):
+            blob = engine.serialize_models(ctx, engine_params, instance_id, models)
+            storage.get_model_data_models().insert(
+                Model(id=instance_id, models=blob)
             )
-        blob = engine.serialize_models(ctx, engine_params, instance_id, models)
-        storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
         instance.status = STATUS_COMPLETED
         instance.end_time = _utcnow()
         instances.update(instance)

@@ -97,6 +97,9 @@ class QueryService:
         feedback: FeedbackConfig | None = None,
         plugins: list[EngineServerPlugin] | None = None,
         batching: BatchConfig | None = None,
+        tracing: bool | None = None,
+        trace_sample: float | None = None,
+        slow_query_ms: float | None = None,
     ):
         self.variant = variant
         self.engine = engine or build_engine(variant)
@@ -119,16 +122,27 @@ class QueryService:
                 help="Queries answered successfully",
             )
 
-        self.router, self.metrics = instrumented_router(before_scrape=mirror)
+        self.router, self.metrics = instrumented_router(
+            before_scrape=mirror, tracing=tracing, trace_sample=trace_sample
+        )
+        if slow_query_ms is not None:
+            # one summary log line per query trace over the threshold
+            self.router.tracer.set_slow_threshold(
+                "POST /queries.json", slow_query_ms / 1000.0
+            )
         self.router.add("GET", "/", self.handle_info)
         self.router.add("POST", "/queries.json", self.handle_query)
         self.router.add("GET", "/reload", self.handle_reload)
         self.router.add("POST", "/stop", self.handle_stop)
         self._stop_event = threading.Event()
         # the batcher captures engine state per flush (under self._lock),
-        # so /reload hot-swaps apply to the very next batch
+        # so /reload hot-swaps apply to the very next batch; it fans
+        # batch-level spans back out to each coalesced request's trace
         self._batcher = (
-            MicroBatcher(self._predict_batch, self.batching, metrics=self.metrics)
+            MicroBatcher(
+                self._predict_batch, self.batching,
+                metrics=self.metrics, tracer=self.router.tracer,
+            )
             if self.batching.enabled
             else None
         )
@@ -278,8 +292,10 @@ class QueryService:
         return [errors[i] if i in errors else served[i] for i in range(n)]
 
     def handle_query(self, request: Request) -> Response:
+        tracer = self.router.tracer
         try:
-            query_obj = request.json()
+            with tracer.span("query.parse"):
+                query_obj = request.json()
         except json.JSONDecodeError:
             return Response(400, {"message": "malformed JSON query"})
         try:
@@ -296,7 +312,8 @@ class QueryService:
                         503, {"message": "batched predict timed out"}
                     )
             else:
-                result = self._predict_one(query_obj)
+                with tracer.span("query.predict"):
+                    result = self._predict_one(query_obj)
             for plugin in self.plugins:
                 plugin.output_blocker(query_obj, result)
         except ServerRejection as exc:
@@ -307,9 +324,10 @@ class QueryService:
             plugin.output_sniffer(query_obj, result)
         with self._lock:
             serializer = self.algorithms[0]
-        result_json = serializer.result_to_json(result)
-        if not isinstance(result_json, (dict, list)):
-            result_json = {"result": result_json}
+        with tracer.span("query.respond"):
+            result_json = serializer.result_to_json(result)
+            if not isinstance(result_json, (dict, list)):
+                result_json = {"result": result_json}
         if self.feedback:
             pr_id = uuid.uuid4().hex
             if isinstance(result_json, dict):

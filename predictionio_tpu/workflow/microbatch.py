@@ -41,6 +41,12 @@ With a ``MetricsRegistry`` attached, every flush records:
   between enqueue and flush,
 - ``pio_serving_batch_flush_total{reason="size"|"deadline"|"idle"|"drain"}``,
 - ``pio_serving_batch_padding_rows_total``: padded slots executed.
+
+With a ``Tracer`` attached (``obs.trace``), every flush fans spans out to
+each coalesced request's trace: a per-request ``batch.queue_wait`` span
+(enqueue -> flush) plus batch-level ``batch.assemble`` and
+``batch.execute`` spans whose span ids are SHARED across the batch -- the
+join key that answers "which requests rode the batch my request rode".
 """
 
 from __future__ import annotations
@@ -52,6 +58,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from queue import Empty, Queue
 from typing import Any, Callable, Sequence
+
+from predictionio_tpu.obs.trace import NULL_TRACER, current_context
 
 logger = logging.getLogger("pio.microbatch")
 
@@ -98,6 +106,13 @@ class _Pending:
     query: Any
     future: Future = field(default_factory=Future)
     enqueued: float = field(default_factory=time.perf_counter)
+    #: (trace_id, span_id) captured on the request thread at submit; the
+    #: flusher fans batch-level spans out to these traces
+    trace_ctx: tuple | None = None
+    #: the live trace's span list, captured at submit while the root is
+    #: guaranteed open -- lets the fan-out run AFTER the future resolves
+    #: (off the ack latency path) and still land in the right trace
+    trace_spans: list | None = None
 
 
 class MicroBatcher:
@@ -113,10 +128,12 @@ class MicroBatcher:
         execute: Callable[[Sequence[Any]], Sequence[Any]],
         config: BatchConfig | None = None,
         metrics=None,
+        tracer=None,
     ):
         self._execute = execute
         self._config = config = config or BatchConfig()
         self._metrics = metrics
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         if config.max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         # the effective ladder: configured buckets capped by max_batch_size,
@@ -153,6 +170,12 @@ class MicroBatcher:
             # and saying so keeps the enqueue-under-lock visibly
             # non-blocking (pio check C002)
             item = _Pending(query)
+            if self._tracer.enabled:
+                item.trace_ctx = current_context()
+                if item.trace_ctx is not None:
+                    item.trace_spans = self._tracer.live_spans(
+                        item.trace_ctx[0]
+                    )
             self._queue.put_nowait(item)
         return item.future
 
@@ -256,16 +279,20 @@ class MicroBatcher:
             batch.append(nxt)
 
     def _flush(self, batch: list[_Pending], reason: str) -> None:
+        flush_pc = time.perf_counter()
         try:
-            self._observe(batch, reason, time.perf_counter())
+            self._observe(batch, reason, flush_pc)
         except Exception:
             # telemetry must never take serving down (or kill the flusher)
             logger.warning("batch metrics recording failed", exc_info=True)
+        exec_pc = flush_pc
+        pad = 0
         try:
             padded = [p.query for p in batch]
             pad = self.pad_to(len(batch)) - len(batch)
             if pad > 0:
                 padded.extend([batch[-1].query] * pad)
+            exec_pc = time.perf_counter()
             results = self._execute(padded)
             if len(results) != len(padded):
                 raise RuntimeError(
@@ -279,12 +306,61 @@ class MicroBatcher:
             logger.warning("batch execution failed wholesale", exc_info=True)
             for p in batch:
                 p.future.set_exception(exc)
+            # the error traces are exactly the ones tail-based retention
+            # exists to keep: they still get their queue-wait and batch
+            # spans, with the execute stage marked as the failure
+            self._trace_fanout(
+                batch, reason, pad, flush_pc, exec_pc, status="error"
+            )
             return
         for p, result in zip(batch, results):  # padding tail dropped
             if isinstance(result, Exception):
                 p.future.set_exception(result)
             else:
                 p.future.set_result(result)
+        # AFTER the futures: every waiting request thread is already
+        # woken; the fan-out's python burns flusher time, not ack latency
+        self._trace_fanout(batch, reason, pad, flush_pc, exec_pc)
+
+    def _trace_fanout(
+        self,
+        batch: list[_Pending],
+        reason: str,
+        pad: int,
+        flush_pc: float,
+        exec_pc: float,
+        status: str = "ok",
+    ) -> None:
+        """Write the batch-level spans into every coalesced request's
+        trace (shared span ids). Called right after execute returns;
+        internally exception-safe -- tracing must never fail a batch."""
+        tracer = self._tracer
+        if not tracer.enabled:
+            return
+        try:
+            done_pc = time.perf_counter()
+            traced = [
+                (p.trace_ctx, p.enqueued, p.trace_spans)
+                for p in batch if p.trace_ctx is not None
+            ]
+            if not traced:
+                return
+            attrs = {
+                "batch_size": len(batch),
+                "padded_to": len(batch) + pad,
+                "reason": reason,
+            }
+            tracer.record_fanout(
+                traced,
+                [
+                    ("batch.assemble", flush_pc, exec_pc),
+                    ("batch.execute", exec_pc, done_pc),
+                ],
+                attrs=attrs,
+                status=status,
+            )
+        except Exception:
+            logger.warning("batch trace recording failed", exc_info=True)
 
     def _observe(self, batch: list[_Pending], reason: str, now: float) -> None:
         if self._metrics is None:

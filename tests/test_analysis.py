@@ -478,6 +478,57 @@ class TestC002:
                         self._queue.put(item, timeout=0.5)
         """) == []
 
+    def test_fires_on_span_export_under_lock(self):
+        """The obs/ policy: ring-buffer appends belong under the tracer
+        lock, any span export/flush I/O does not -- an exporter call under
+        a lock serializes every instrumented hot path behind its I/O."""
+        hits = run_rule(RuleC002, """
+            import threading
+
+            class T:
+                def __init__(self, exporter):
+                    self._lock = threading.Lock()
+                    self._exporter = exporter
+                    self._spans = []
+
+                def a(self, span):
+                    with self._lock:
+                        self._exporter.export([span])
+
+                def b(self):
+                    with self._lock:
+                        self._exporter.force_flush()
+
+                def c(self, tracer):
+                    with self._lock:
+                        tracer.flush()
+        """)
+        assert sorted(f.symbol for f in hits) == ["T.a", "T.b", "T.c"]
+        assert all("span export" in f.message for f in hits)
+
+    def test_silent_on_file_flush_and_unlocked_export(self):
+        """A plain file/stream ``.flush()`` under a lock stays accepted
+        (the WAL's buffered-write flush shape), and exports OUTSIDE the
+        critical section are the fix shape, not a finding."""
+        assert run_rule(RuleC002, """
+            import threading
+
+            class T:
+                def __init__(self, exporter, f):
+                    self._lock = threading.Lock()
+                    self._exporter = exporter
+                    self._file = f
+
+                def a(self):
+                    with self._lock:
+                        self._file.flush()
+
+                def b(self, span):
+                    with self._lock:
+                        batch = [span]
+                    self._exporter.export(batch)
+        """) == []
+
 
 # -- C003: unlocked cross-thread mutation -------------------------------------
 
