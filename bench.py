@@ -514,6 +514,46 @@ def secondary_main(result_path: str) -> None:
             " production-default sampling, median of 5 paired rounds)",
         }
 
+    def serving_qps_multiproc():
+        """#12: aggregate query-server QPS, single-process
+        ThreadingHTTPServer vs the multi-process tier (SO_REUSEPORT
+        frontend workers + shared-memory rings into one scorer), same
+        micro-batched scorer, identical raw-socket load at 32 clients
+        (the stock http.client generator saturates near ~600 qps on this
+        box -- below the process tier -- so it would measure itself).
+        Includes the coalescing identity check: every arm's bodies come
+        from the same scorer router. CPU-only like serving_qps."""
+        if tpu:
+            return {
+                "skipped": "CPU-only phase (TPU child shares an already-"
+                "initialized backend)"
+            }
+        from predictionio_tpu.tools.serving_bench import run_multiproc_ab
+
+        rep = run_multiproc_ab(
+            "recommendation",
+            concurrency=32,
+            requests=2000,
+            workers=(1, 2),
+            users=300,
+            items=30_000,
+            events=60_000,
+        )
+        out = {
+            "qps_singleproc": rep["singleproc"]["qps"],
+            "responses_identical": rep["responses_identical"],
+            "responses_equivalent": rep["responses_equivalent"],
+            "qps_speedup": rep["qps_speedup"],
+            "config": "#12 serving_qps_multiproc (32 raw clients, 30k"
+            " items, rank 64, workers 1/2)",
+        }
+        for label in ("workers_1", "workers_2"):
+            if label in rep:
+                out[f"qps_{label}"] = rep[label]["qps"]
+                out[f"p50_ms_{label}"] = rep[label]["p50_ms"]
+                out[f"failures_{label}"] = rep[label]["failures"]
+        return out
+
     def analysis_findings():
         """#10: the `pio check` static-analysis gate as a zero-cost
         regression metric. `analysis_findings_total` (unsuppressed) must
@@ -550,6 +590,7 @@ def secondary_main(result_path: str) -> None:
     phase("train_data_eps", train_data_eps)
     phase("als_half_step_gbps", als_half_step_gbps)
     phase("trace_overhead_pct", trace_overhead_pct)
+    phase("serving_qps_multiproc", serving_qps_multiproc)
     phase("analysis_findings", analysis_findings)
 
 

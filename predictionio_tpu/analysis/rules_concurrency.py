@@ -21,6 +21,8 @@ C003_SCOPE = (
     "data/snapshot.py",
     "workflow/microbatch.py",
     "utils/metrics.py",
+    "serving/frontend.py",
+    "serving/procserver.py",
 )
 
 _LOCK_CTORS = {
@@ -372,4 +374,188 @@ class RuleC003:
         return out
 
 
-RULES = (RuleC001, RuleC002, RuleC003)
+class RuleC004:
+    """``fork()``-flavored child creation in a threads-and-locks package.
+    Incident class: the multi-process serving tier (PR 8). Every service
+    module here starts threads and holds locks (batcher flusher, ingest
+    writer, metrics registry locks, the tracer lock); a ``fork()`` child
+    inherits a snapshot where those locks may be HELD by threads that do
+    not exist in the child -- the next acquire deadlocks forever -- and
+    where registries/rings are silently duplicated, so counters fork too.
+    The fix shape is the one ``serving/procserver.py`` uses: spawn a
+    FRESH interpreter (``subprocess.Popen`` or a ``get_context("spawn")``
+    multiprocessing context) and hand state across explicitly (fds via
+    ``pass_fds``, shared files by path).
+
+    Flags, anywhere in the package:
+
+    - ``os.fork()`` / ``os.forkpty()`` calls;
+    - ``multiprocessing.set_start_method("fork")`` /
+      ``get_context("fork")``;
+    - ``Process(...)`` constructions whose context is the platform
+      default or a fork context (on Linux the default IS fork) -- a
+      ``get_context("spawn")``/``"forkserver"`` context is the negative;
+    - lock/registry/tracer/batcher-shaped state passed as ``Process``
+      args (inherited-across-fork hazard even when it pickles).
+    """
+
+    rule_id = "C004"
+    severity = "error"
+
+    #: dotted-arg name TOKENS (split on "."/"_") that look like
+    #: cross-fork-hazardous state; token equality, not substring -- a
+    #: substring match flagged 'wall_clock' (lock) and 'timeout_seconds'
+    #: (cond), and C004 is error-severity
+    _STATE_HINTS = frozenset((
+        "lock", "locks", "rlock", "mutex", "registry", "tracer",
+        "batcher", "sem", "semaphore", "cond", "condition",
+    ))
+    _SAFE_CONTEXTS = ("spawn", "forkserver")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # one walk collects everything; modules that never touch fork/
+        # multiprocessing (almost all of them) exit before any per-call
+        # analysis, keeping the full-package sweep inside its budget
+        mp_aliases, process_names, calls, assigns = self._collect(ctx)
+        if not (mp_aliases or process_names) and not any(
+            call_name(c) in ("os.fork", "os.forkpty") for c in calls
+        ):
+            return
+        spawn_ctx, fork_ctx = self._context_names(assigns)
+        for call in calls:
+            name = call_name(call)
+            if name in ("os.fork", "os.forkpty"):
+                yield Finding(
+                    self.rule_id, self.severity, ctx.path, call.lineno,
+                    ctx.symbol_for(call),
+                    "os.fork() in a package whose modules start threads "
+                    "and hold locks: the child inherits possibly-held "
+                    "locks with no owner thread",
+                    "exec a fresh interpreter (subprocess.Popen) or use a "
+                    "multiprocessing spawn context",
+                )
+                continue
+            if name.endswith((".set_start_method", ".get_context")) or name in (
+                "set_start_method", "get_context"
+            ):
+                root = name.split(".")[0]
+                if "." in name and root not in mp_aliases and not (
+                    root in fork_ctx or root in spawn_ctx
+                ):
+                    continue
+                if call.args and isinstance(call.args[0], ast.Constant) and (
+                    call.args[0].value == "fork"
+                ):
+                    yield Finding(
+                        self.rule_id, self.severity, ctx.path, call.lineno,
+                        ctx.symbol_for(call),
+                        "explicit multiprocessing 'fork' start method: "
+                        "forked children inherit this package's locks and "
+                        "registries mid-state",
+                        'use get_context("spawn") (fresh interpreter) and '
+                        "pass state explicitly",
+                    )
+                continue
+            is_process = False
+            if name.endswith(".Process"):
+                root = name.rsplit(".", 1)[0]
+                if root in spawn_ctx:
+                    # the documented fix shape -- still check the args
+                    yield from self._check_args(ctx, call)
+                    continue
+                is_process = root in mp_aliases or root in fork_ctx
+            elif name in process_names:
+                # covers `from multiprocessing import Process` AND its
+                # aliased form (`... import Process as P; P(...)`)
+                is_process = True
+            if is_process:
+                yield Finding(
+                    self.rule_id, self.severity, ctx.path, call.lineno,
+                    ctx.symbol_for(call),
+                    "multiprocessing.Process under the platform-default "
+                    "start method (fork on Linux): the child inherits "
+                    "this package's locks and registries mid-state",
+                    'use get_context("spawn").Process or subprocess.Popen',
+                )
+                yield from self._check_args(ctx, call)
+
+    def _check_args(self, ctx: ModuleContext, call: ast.Call) -> Iterator[Finding]:
+        """Lock/registry-shaped state handed to a child process: even a
+        spawn context duplicates it (or fails to pickle it at runtime);
+        either way the two copies silently diverge."""
+        arg_nodes: list[ast.AST] = list(call.args)
+        for kw in call.keywords:
+            arg_nodes.append(kw.value)
+        for node in arg_nodes:
+            for sub in ast.walk(node):
+                d = dotted(sub)
+                if d is None:
+                    continue
+                tokens = d.lower().replace(".", "_").split("_")
+                if any(t in self._STATE_HINTS for t in tokens):
+                    yield Finding(
+                        self.rule_id, self.severity, ctx.path, call.lineno,
+                        ctx.symbol_for(call),
+                        f"{d!r} handed to a child process: lock/registry "
+                        "state inherited across the process boundary "
+                        "diverges silently (or deadlocks if fork-inherited "
+                        "while held)",
+                        "share by path/fd (ring file, pass_fds) and rebuild "
+                        "the object in the child",
+                    )
+                    break
+
+    @staticmethod
+    def _collect(ctx: ModuleContext) -> tuple:
+        """One pass over the module: multiprocessing import aliases,
+        names bound to its Process class, every Call node, and every
+        Assign-from-Call (context-variable candidates)."""
+        mp_aliases: set[str] = set()
+        process_names: set[str] = set()
+        calls: list[ast.Call] = []
+        assigns: list[ast.Assign] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                assigns.append(node)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "multiprocessing":
+                        mp_aliases.add(alias.asname or "multiprocessing")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "multiprocessing":
+                    for alias in node.names:
+                        if alias.name == "Process":
+                            process_names.add(alias.asname or "Process")
+                        if alias.name in ("get_context", "set_start_method"):
+                            mp_aliases.add("")  # bare calls resolve to mp
+        return mp_aliases, process_names, calls, assigns
+
+    def _context_names(
+        self, assigns: "list[ast.Assign]"
+    ) -> tuple[set[str], set[str]]:
+        """Names assigned from ``get_context("spawn"|"forkserver")`` vs
+        ``get_context("fork")`` / bare ``get_context()``."""
+        spawn_ctx: set[str] = set()
+        fork_ctx: set[str] = set()
+        for node in assigns:
+            name = call_name(node.value)
+            if not (name == "get_context" or name.endswith(".get_context")):
+                continue
+            method = None
+            if node.value.args and isinstance(node.value.args[0], ast.Constant):
+                method = node.value.args[0].value
+            target_names = {
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            }
+            if method in self._SAFE_CONTEXTS:
+                spawn_ctx |= target_names
+            else:
+                fork_ctx |= target_names
+        return spawn_ctx, fork_ctx
+
+
+RULES = (RuleC001, RuleC002, RuleC003, RuleC004)

@@ -148,6 +148,25 @@ def compute_stats(prev: dict, cur: dict) -> dict:
     depth = cm.get("pio_ingest_queue_depth")
     if depth:
         stats["ingest_queue_depth"] = int(sum(depth.values()))
+    serving_depth = cm.get("pio_serving_queue_depth")
+    if serving_depth:
+        stats["ingest_queue_depth"] = stats.get(
+            "ingest_queue_depth", 0
+        ) + int(sum(serving_depth.values()))
+    workers = cm.get("pio_frontend_workers")
+    if workers:
+        # the multi-process serving tier: configured frontend count plus
+        # the per-worker forwarded totals (aggregated across processes)
+        stats["frontend_workers"] = int(sum(workers.values()))
+        fw_req = cm.get("pio_frontend_requests_total", {})
+        pfw_req = pm.get("pio_frontend_requests_total", {})
+        # clamp per series: a respawned worker restarts its counters at
+        # zero while the scrape stays healthy, so an un-clamped delta
+        # would render a large negative qps for that poll interval
+        d_fw = sum(
+            max(v - pfw_req.get(k, 0.0), 0.0) for k, v in fw_req.items()
+        )
+        stats["frontend_qps"] = round(d_fw / dt, 1)
     d_batches = _total(cm.get("pio_serving_batch_size_count")) - _total(
         pm.get("pio_serving_batch_size_count")
     )
@@ -172,7 +191,7 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
         time.strftime("pio top — %H:%M:%S", time.localtime()),
         "",
         f"{'SERVICE':<32}{'QPS':>8}{'P50MS':>9}{'P99MS':>9}"
-        f"{'ERR%':>7}{'QUEUE':>7}{'BATCH':>7}",
+        f"{'ERR%':>7}{'QUEUE':>7}{'BATCH':>7}{'WKR':>5}",
     ]
     for s in stats_list:
         if s.get("error"):
@@ -186,6 +205,7 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
             f"{_fmt(round(s.get('error_rate', 0.0) * 100, 1)):>7}"
             f"{_fmt(s.get('ingest_queue_depth')):>7}"
             f"{_fmt(s.get('batch_occupancy')):>7}"
+            f"{_fmt(s.get('frontend_workers')):>5}"
         )
     slowest: list[tuple[float, str, dict]] = []
     for snap in snapshots:

@@ -1,0 +1,17 @@
+"""Multi-process serving tier: SO_REUSEPORT HTTP frontends + shm rings.
+
+The query-serving ceiling on a small box is the GIL-serialized python of
+the HTTP stack itself (~2.5 ms/request -> ~400 qps at 32 clients on the
+2-core box), not the models. This package splits serving into N frontend
+WORKER PROCESSES -- each binds its own ``SO_REUSEPORT`` listener and runs
+an accept/parse/validate loop -- feeding one device-owning SCORER process
+(the existing :class:`~predictionio_tpu.workflow.create_server.QueryService`
+with its ``MicroBatcher`` unchanged) through per-worker shared-memory
+message rings. "Add a core" becomes "add a frontend worker".
+
+This ``__init__`` must stay import-light: the frontend worker entry point
+(``python -m predictionio_tpu.serving.frontend``) runs in a fresh
+interpreter per worker and must come up in well under a second -- no jax,
+no storage, no engine imports (``predictionio_tpu.workflow`` pulls in all
+three).
+"""

@@ -1,0 +1,544 @@
+"""Scorer-side bridge of the multi-process serving tier.
+
+The scorer process (the one that owns the device, the models, and the
+``MicroBatcher``) runs a :class:`ScorerBridge` instead of an HTTP
+listener: it spawns N frontend worker processes (fresh interpreters via
+``subprocess`` -- never ``fork()``: this process is full of threads and
+locks, the exact hazard ``pio check`` C004 exists for), consumes their
+request rings, dispatches each message through the unchanged
+:class:`~predictionio_tpu.utils.http.Router` on a thread pool (concurrent
+dispatch is what lets the micro-batcher keep coalescing), and writes
+responses back to each worker's completion ring.
+
+Port discovery without a blackhole window: the bridge binds ONE
+``SO_REUSEPORT`` socket on the requested port (port 0 resolves to a real
+ephemeral port) and keeps it bound but **never listening** -- a TCP
+socket that has not called ``listen()`` is not in the kernel's
+``SO_REUSEPORT`` delivery group, so it reserves the port for respawns
+without stealing SYNs from the workers.
+
+Supervision: a SIGKILLed worker is respawned with a fresh ring file under
+a bumped generation; completions addressed to the dead generation are
+dropped (its clients are gone with its sockets), and everything else
+keeps serving. Backpressure: the bridge admits at most ``max_inflight``
+requests into the dispatch pool; beyond that it simply stops popping, the
+rings fill, and the frontends answer 429 -- the ingest pipeline's bounded
+-queue contract at the serving tier.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import select
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+from urllib.parse import parse_qs, urlsplit
+
+from predictionio_tpu.serving import shmring
+from predictionio_tpu.utils.http import Request
+
+logger = logging.getLogger("pio.procserver")
+
+
+@dataclass
+class FrontendConfig:
+    """Process-tier knobs (CLI: ``pio deploy --frontend-workers N``)."""
+
+    workers: int = 2
+    #: per-direction ring capacity (messages); the backpressure horizon
+    ring_slots: int = 128
+    #: per-slot byte budget; bigger messages spill to one-off files
+    slot_bytes: int = 32768
+    #: concurrent dispatches admitted into the scorer (= dispatcher
+    #: threads; also the coalescing ceiling the micro-batcher sees).
+    #: Deliberately small: a wide pool looks tempting, but measured on
+    #: the 2-core box 64 dispatcher threads collapsed throughput 13x --
+    #: every batch completion woke a thread herd that thrashed the GIL
+    #: and scheduler -- while 8-16 threads kept the scorer at full rate
+    max_inflight: int = 16
+    #: how often a worker publishes its metrics snapshot
+    stats_flush_s: float = 0.25
+    #: how long to wait for a spawned worker to reach READY
+    spawn_timeout_s: float = 40.0
+
+    def describe(self) -> dict:
+        return {
+            "workers": self.workers,
+            "ringSlots": self.ring_slots,
+            "slotBytes": self.slot_bytes,
+            "maxInflight": self.max_inflight,
+        }
+
+
+class _Worker:
+    """One spawned frontend: its ring, process handle, and generation."""
+
+    def __init__(self, index: int, generation: int, ring: shmring.RingFile,
+                 proc: subprocess.Popen):
+        self.index = index
+        self.generation = generation
+        self.ring = ring
+        self.proc = proc
+        self.dead = False
+        #: serializes pool threads producing into the SPSC completion ring
+        self.cmp_lock = threading.Lock()
+
+
+class ScorerBridge:
+    """Spawn/supervise frontends; pump rings through the router."""
+
+    def __init__(
+        self,
+        router,
+        host: str,
+        port: int,
+        config: FrontendConfig | None = None,
+        server_name: str = "pio-queryserver",
+        registry=None,
+    ):
+        self._router = router
+        self._host = host
+        self._requested_port = port
+        self.config = config or FrontendConfig()
+        if self.config.workers < 1:
+            raise ValueError("frontend workers must be >= 1")
+        self._server_name = server_name
+        self._registry = registry
+        self._reserve: socket.socket | None = None
+        self.port: int | None = None
+        self._dir: str | None = None
+        #: index -> (req, cmp, stop) wakeups; created once, reused across
+        #: respawns so the consumer's select set never churns
+        self._wakes: dict[int, tuple] = {}
+        self._workers: list[_Worker] = []
+        self._lock = threading.Lock()
+        self._inflight = threading.Semaphore(self.config.max_inflight)
+        self._draining = False
+        self._stopping = False
+        #: consumer -> dispatcher hand-off; SimpleQueue's C put/get is the
+        #: cheapest in-process wakeup available (no Future allocation)
+        self._work: queue.SimpleQueue = queue.SimpleQueue()
+        self._dispatchers: list[threading.Thread] = []
+        self._consumer: threading.Thread | None = None
+        self._supervisor: threading.Thread | None = None
+        self._respawns = 0
+        #: serializes stop() callers end-to-end (idempotent teardown)
+        self._stop_lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ScorerBridge":
+        if not hasattr(socket, "SO_REUSEPORT"):
+            raise RuntimeError(
+                "multi-process serving needs SO_REUSEPORT (Linux/BSD); "
+                "deploy without --frontend-workers on this platform"
+            )
+        try:
+            self._reserve = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._reserve.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            self._reserve.bind((self._host, self._requested_port))
+            self.port = self._reserve.getsockname()[1]
+            self._dir = tempfile.mkdtemp(prefix="pio-frontend-")
+            for k in range(self.config.max_inflight):
+                t = threading.Thread(
+                    target=self._dispatch_loop, name=f"pio-scorer-{k}",
+                    daemon=True,
+                )
+                t.start()
+                self._dispatchers.append(t)
+            for i in range(self.config.workers):
+                self._wakes[i] = (
+                    shmring.Wakeup.create(self._dir, f"req-{i}"),
+                    shmring.Wakeup.create(self._dir, f"cmp-{i}"),
+                    shmring.Wakeup.create(self._dir, f"stop-{i}"),
+                )
+                self._workers.append(self._launch(i, generation=1))
+            self._await_ready(self._workers)
+        except BaseException:
+            # a half-started tier must not outlive this call: workers
+            # that already reached READY are listening on the port with
+            # no consumer behind them -- clients would hang, and the
+            # orphans would hold the port after the parent dies
+            self._teardown(kill=True)
+            raise
+        self._consumer = threading.Thread(
+            target=self._consume, name="pio-scorer-consumer", daemon=True
+        )
+        self._consumer.start()
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="pio-scorer-supervisor", daemon=True
+        )
+        self._supervisor.start()
+        self._gauge_workers()
+        return self
+
+    def _launch(self, index: int, generation: int) -> _Worker:
+        path = os.path.join(self._dir, f"worker-{index}.ring")
+        ring = shmring.RingFile.create(
+            path, self.config.ring_slots, self.config.slot_bytes, generation
+        )
+        wake_req, wake_cmp, wake_stop = self._wakes[index]
+        cmd = [
+            sys.executable, "-m", "predictionio_tpu.serving.frontend",
+            "--ring", path,
+            "--host", self._host,
+            "--port", str(self.port),
+            "--worker", str(index),
+            "--wake-req", wake_req.spec(),
+            "--wake-cmp", wake_cmp.spec(),
+            "--wake-stop", wake_stop.spec(),
+            "--server-name", self._server_name,
+            "--stats-flush-s", str(self.config.stats_flush_s),
+        ]
+        env = dict(os.environ)
+        # the worker interpreter must find this package without an install
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        pass_fds = tuple(
+            fd for w in (wake_req, wake_cmp, wake_stop)
+            if (fd := w.pass_fd) is not None
+        )
+        log = open(os.path.join(self._dir, f"worker-{index}.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd, pass_fds=pass_fds, env=env,
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+        finally:
+            log.close()
+        logger.info(
+            "frontend worker %d spawned (pid %d, generation %d)",
+            index, proc.pid, generation,
+        )
+        return _Worker(index, generation, ring, proc)
+
+    def _await_ready(self, workers: list[_Worker]) -> None:
+        deadline = time.monotonic() + self.config.spawn_timeout_s
+        pending = list(workers)
+        while pending:
+            pending = [
+                w for w in pending if w.ring.state == shmring.STATE_INIT
+            ]
+            if not pending:
+                return
+            for w in pending:
+                if w.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"frontend worker {w.index} exited "
+                        f"rc={w.proc.returncode} before READY "
+                        f"(log: {self._worker_log_tail(w.index)!r})"
+                    )
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"frontend worker(s) "
+                    f"{[w.index for w in pending]} not READY within "
+                    f"{self.config.spawn_timeout_s}s"
+                )
+            time.sleep(0.02)
+
+    def _worker_log_tail(self, index: int, limit: int = 500) -> str:
+        try:
+            with open(os.path.join(self._dir, f"worker-{index}.log"), "rb") as f:
+                return f.read()[-limit:].decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    def stop(self) -> None:
+        """Graceful drain: workers stop accepting and finish in-flight
+        requests (the bridge keeps dispatching while they do), then the
+        pool drains and everything is torn down. Idempotent; concurrent
+        callers serialize and the second is a no-op."""
+        with self._stop_lock:
+            self._stop_locked()
+
+    def _stop_locked(self) -> None:
+        with self._lock:
+            if self._stopping:
+                return
+            self._draining = True
+        for _, _, wake_stop in self._wakes.values():
+            wake_stop.signal()
+        # a draining worker legitimately waits up to the frontend's
+        # forward timeout for an in-flight answer (first-bucket jit
+        # compiles are the sized-for case); killing it sooner would drop
+        # exactly the requests the drain contract promises to answer
+        from predictionio_tpu.serving.frontend import FORWARD_TIMEOUT_S
+
+        deadline = time.monotonic() + FORWARD_TIMEOUT_S + 5.0
+        for w in list(self._workers):
+            timeout = max(deadline - time.monotonic(), 0.1)
+            try:
+                w.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                logger.warning(
+                    "frontend worker %d did not drain; killing", w.index
+                )
+                w.proc.kill()
+                try:
+                    w.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        with self._lock:
+            self._stopping = True
+        if self._consumer is not None:
+            self._consumer.join(timeout=5.0)
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5.0)
+        self._teardown()
+
+    def _teardown(self, kill: bool = False) -> None:
+        """Release every tier resource; with ``kill`` the workers are
+        SIGKILLed first (the start()-failed path, where a graceful drain
+        has nothing to drain and orphans must not survive)."""
+        with self._lock:
+            self._draining = True
+            self._stopping = True
+        for w in self._workers:
+            if kill and w.proc.poll() is None:
+                w.proc.kill()
+        for w in self._workers:
+            try:
+                w.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+        # sentinels queue BEHIND any in-flight work: dispatchers finish
+        # the stragglers, then exit
+        for _ in self._dispatchers:
+            self._work.put(None)
+        for t in self._dispatchers:
+            t.join(timeout=10.0)
+        for w in self._workers:
+            w.ring.close()
+        for wakes in self._wakes.values():
+            for wake in wakes:
+                wake.close()
+        if self._reserve is not None:
+            self._reserve.close()
+            self._reserve = None
+        if self._dir is not None:
+            shutil.rmtree(self._dir, ignore_errors=True)
+
+    # -- request pump -------------------------------------------------------
+    def _consume(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopping:
+                    return
+                workers = list(self._workers)
+            progressed = False
+            for w in workers:
+                if w.dead:
+                    continue
+                try:
+                    while w.ring.requests.pending():
+                        # admission control: no permit -> stop popping;
+                        # the ring backs up and the frontend answers 429
+                        if not self._inflight.acquire(timeout=0.5):
+                            with self._lock:
+                                if self._stopping:
+                                    return
+                            break
+                        msg = w.ring.requests.pop()
+                        if msg is None:
+                            self._inflight.release()
+                            break
+                        progressed = True
+                        self._work.put((w, msg))
+                except (ValueError, OSError):
+                    # the supervisor retired this worker and closed its
+                    # ring between our dead-check and the read; the ONLY
+                    # popping thread must survive the race, not die on it
+                    if not w.dead:
+                        logger.exception(
+                            "request ring read failed for live worker %d",
+                            w.index,
+                        )
+                    continue
+            if progressed:
+                continue
+            fds = [wakes[0].fileno() for wakes in self._wakes.values()]
+            try:
+                ready, _, _ = select.select(fds, [], [], 0.25)
+            except OSError:
+                ready = []
+            for wakes in self._wakes.values():
+                if wakes[0].fileno() in ready:
+                    wakes[0].drain()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._work.get()
+            if item is None:
+                return
+            self._handle(*item)
+
+    def _handle(self, w: _Worker, msg: tuple) -> None:
+        try:
+            meta, body = msg
+            parsed = urlsplit(meta["t"])
+            query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            request = Request(
+                method=meta["m"],
+                path=parsed.path,
+                query=query,
+                headers=dict(meta.get("h") or {}),
+                body=body,
+                path_params={},
+                frontend_pc=(
+                    meta["p"], time.perf_counter(), meta.get("w", "?")
+                ),
+            )
+            try:
+                response = self._router.dispatch(request)
+            except Exception:
+                # the router has its own backstops; anything escaping is a
+                # dispatch-layer bug, answered like make_server would
+                logger.exception("dispatch failed for %s", parsed.path)
+                from predictionio_tpu.utils.http import Response
+
+                response = Response(500, {"message": "internal server error"})
+            payload = response.payload()
+            rmeta = {
+                "i": meta["i"],
+                "s": response.status,
+                "c": response.content_type,
+                "h": response.headers,
+            }
+            # a briefly-descheduled worker (measured: ~300 ms scheduler
+            # stalls under load on sandboxed kernels) can leave its
+            # completion ring momentarily full; DROPPING here turns that
+            # stall into a full client timeout, so retry with a bounded
+            # deadline instead -- the worker only has to run once within
+            # it to drain 128 slots
+            deadline = time.monotonic() + 5.0
+            while True:
+                with w.cmp_lock:
+                    if w.dead:
+                        # a respawn retired this worker mid-score: its
+                        # clients died with its sockets, drop the answer
+                        break
+                    try:
+                        w.ring.completions.push(rmeta, payload)
+                        break
+                    except shmring.RingFull:
+                        pass
+                self._wakes[w.index][1].signal()
+                if time.monotonic() > deadline:
+                    logger.warning(
+                        "completion ring full for worker %d for >5s; "
+                        "dropping response", w.index,
+                    )
+                    break
+                time.sleep(0.002)
+            self._wakes[w.index][1].signal()
+        except Exception:
+            logger.exception("completion delivery failed")
+        finally:
+            self._inflight.release()
+
+    # -- supervision --------------------------------------------------------
+    #: consecutive failed respawns of one worker index before giving up
+    #: (the index stays down; serving continues on surviving workers)
+    _MAX_RESPAWN_FAILURES = 6
+
+    def _supervise(self) -> None:
+        #: index -> (consecutive failures, next attempt monotonic time)
+        backoff: dict[int, tuple[int, float]] = {}
+        while True:
+            time.sleep(0.2)
+            with self._lock:
+                if self._stopping or self._draining:
+                    return
+                workers = list(self._workers)
+            for w in workers:
+                if w.proc.poll() is None or w.dead:
+                    continue
+                logger.warning(
+                    "frontend worker %d died (rc=%s); respawning",
+                    w.index, w.proc.returncode,
+                )
+                w.dead = True
+                backoff.setdefault(w.index, (0, time.monotonic()))
+            for index in sorted(backoff):
+                failures, next_try = backoff[index]
+                if time.monotonic() < next_try:
+                    continue
+                old = self._workers[index]
+                replacement = self._launch(index, old.generation + 1)
+                try:
+                    self._await_ready([replacement])
+                except RuntimeError:
+                    # a replacement that never reached READY must NOT be
+                    # installed (the next sweep would respawn it at 5/s
+                    # forever); back off exponentially, then give up loud
+                    logger.exception(
+                        "respawned frontend worker %d failed to start "
+                        "(attempt %d)", index, failures + 1,
+                    )
+                    replacement.proc.kill()
+                    replacement.ring.close()
+                    failures += 1
+                    if failures >= self._MAX_RESPAWN_FAILURES:
+                        logger.error(
+                            "giving up on frontend worker %d after %d "
+                            "failed respawns; serving continues on the "
+                            "remaining workers", index, failures,
+                        )
+                        del backoff[index]
+                    else:
+                        backoff[index] = (
+                            failures,
+                            time.monotonic() + min(0.5 * 2 ** failures, 30.0),
+                        )
+                    continue
+                with self._lock:
+                    if self._draining or self._stopping:
+                        replacement.proc.kill()
+                        return
+                    self._workers[index] = replacement
+                    self._respawns += 1
+                del backoff[index]
+                with old.cmp_lock:
+                    # dead=True is already visible: in-flight completions
+                    # skip the push, so nobody holds the mapping we close
+                    old.ring.close()
+                self._gauge_workers()
+
+    def _gauge_workers(self) -> None:
+        if self._registry is None:
+            return
+        self._registry.set_gauge(
+            "pio_frontend_workers", float(self.config.workers),
+            help="Configured frontend worker processes",
+        )
+        self._registry.set_counter(
+            "pio_frontend_respawns_total", float(self._respawns),
+            help="Frontend workers respawned after unexpected exit",
+        )
+
+    # -- metrics aggregation ------------------------------------------------
+    def metric_snapshots(self) -> list[dict]:
+        """Every live worker's published registry snapshot (the
+        ``extra_snapshots`` hook of ``instrumented_router``)."""
+        with self._lock:
+            workers = list(self._workers)
+        out = []
+        for w in workers:
+            if w.dead:
+                continue
+            try:
+                snap = w.ring.read_stats()
+            except (ValueError, OSError):
+                continue  # retired ring closed mid-scrape
+            if snap:
+                out.append(snap)
+        return out

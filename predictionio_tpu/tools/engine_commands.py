@@ -97,6 +97,24 @@ def register(sub: argparse._SubParsersAction) -> None:
         "once per bucket",
     )
     deploy.add_argument(
+        "--frontend-workers", type=int, default=0, metavar="N",
+        help="multi-process serving tier: N SO_REUSEPORT frontend "
+        "processes parse/validate HTTP and feed this process's scorer "
+        "through shared-memory rings ('add a core' = 'add a worker'); "
+        "0 (default) serves single-process",
+    )
+    deploy.add_argument(
+        "--frontend-ring-slots", type=int, default=128, metavar="SLOTS",
+        help="per-worker request/completion ring capacity; a full request "
+        "ring answers 429 + Retry-After (scorer backpressure)",
+    )
+    deploy.add_argument(
+        "--frontend-max-inflight", type=int, default=16, metavar="N",
+        help="concurrent dispatches the scorer admits (= dispatcher "
+        "threads and the micro-batcher's coalescing ceiling) before "
+        "letting the rings back up (the backpressure horizon)",
+    )
+    deploy.add_argument(
         "--no-tracing", action="store_true",
         help="disable the span tracer (/traces.json reports enabled=false;"
         " the off path allocates no spans)",
@@ -224,6 +242,21 @@ def cmd_deploy(args: argparse.Namespace) -> int:
             f"Error: --batch-buckets must be comma-separated integers, "
             f"got {args.batch_buckets!r}"
         )
+    frontend = None
+    if args.frontend_workers > 0:
+        if args.ssl_cert or args.ssl_key:
+            raise SystemExit(
+                "Error: --frontend-workers does not support TLS "
+                "(--ssl-cert/--ssl-key); terminate TLS in front of the "
+                "frontend tier or deploy single-process"
+            )
+        from predictionio_tpu.serving.procserver import FrontendConfig
+
+        frontend = FrontendConfig(
+            workers=args.frontend_workers,
+            ring_slots=args.frontend_ring_slots,
+            max_inflight=args.frontend_max_inflight,
+        )
     run_query_server(
         variant,
         host=args.ip,
@@ -240,6 +273,7 @@ def cmd_deploy(args: argparse.Namespace) -> int:
         tracing=False if args.no_tracing else None,
         trace_sample=args.trace_sample,
         slow_query_ms=args.slow_query_ms,
+        frontend=frontend,
     )
     return 0
 

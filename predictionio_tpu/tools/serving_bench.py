@@ -48,6 +48,7 @@ def run_load(
     clients: int = 8,
     requests: int = 400,
     timeout: float = 30.0,
+    client: str = "http",
 ) -> dict:
     """N keep-alive clients, ``requests`` total POSTs; latency stats in ms.
 
@@ -55,6 +56,15 @@ def run_load(
     SDKs' connection-pool behavior); failures are counted, not raised,
     so a mid-run hiccup yields a truthful report instead of a stack
     trace.
+
+    ``client="raw"`` swaps ``http.client`` for a minimal raw-socket
+    client (~5x less python per request). The load generator shares the
+    benchmarked box's cores with the server: with the default client the
+    GENERATOR saturates around ~600 qps on the 2-core box, so any server
+    faster than that measures the client, not the server. The
+    multi-process serving A/B uses raw for exactly this reason; the
+    single-process batching/tracing A/Bs keep the historical client so
+    their BASELINE.md numbers stay comparable.
     """
     parsed = urllib.parse.urlsplit(url)
     body = query if isinstance(query, str) else json.dumps(query)
@@ -67,7 +77,7 @@ def run_load(
     failures = [0] * clients
     start_gate = threading.Event()
 
-    def client(k: int) -> None:
+    def http_client(k: int) -> None:
         conn_cls = (
             http.client.HTTPSConnection
             if parsed.scheme == "https"
@@ -96,8 +106,70 @@ def run_load(
             lat_ms[k].append((time.perf_counter() - t0) * 1000.0)
         conn.close()
 
+    request_bytes = (
+        f"POST /queries.json HTTP/1.1\r\n"
+        f"Host: {parsed.hostname}:{parsed.port}\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n"
+    ).encode() + payload
+
+    def raw_client(k: int) -> None:
+        import socket
+
+        def connect():
+            s = socket.create_connection(
+                (parsed.hostname, parsed.port), timeout=timeout
+            )
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return s
+
+        sock = connect()
+        buf = b""
+        start_gate.wait()
+        for _ in range(counts[k]):
+            t0 = time.perf_counter()
+            try:
+                sock.sendall(request_bytes)
+                while b"\r\n\r\n" not in buf:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        raise OSError("server closed connection")
+                    buf += chunk
+                head, _, buf = buf.partition(b"\r\n\r\n")
+                status = int(head.split(b" ", 2)[1])
+                length = 0
+                for line in head.split(b"\r\n")[1:]:
+                    if line[:15].lower() == b"content-length:":
+                        length = int(line[15:])
+                        break
+                while len(buf) < length:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        raise OSError("truncated response body")
+                    buf += chunk
+                buf = buf[length:]
+                if status != 200:
+                    failures[k] += 1
+                    continue
+            except (OSError, ValueError):
+                failures[k] += 1
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                buf = b""
+                try:
+                    sock = connect()
+                except OSError:
+                    failures[k] += counts[k] - len(lat_ms[k]) - failures[k]
+                    return
+                continue
+            lat_ms[k].append((time.perf_counter() - t0) * 1000.0)
+        sock.close()
+
+    worker = raw_client if client == "raw" else http_client
     threads = [
-        threading.Thread(target=client, args=(k,), daemon=True)
+        threading.Thread(target=worker, args=(k,), daemon=True)
         for k in range(clients)
     ]
     for t in threads:
@@ -281,7 +353,8 @@ def _synthetic_deployment(engine: str, users, items, events):
 
 
 def _load_in_subprocess(
-    url: str, concurrency: int, n_requests: int, query: dict
+    url: str, concurrency: int, n_requests: int, query: dict,
+    client: str = "http",
 ) -> dict:
     """Drive ``run_load`` from a child interpreter: a co-resident client
     pool would fight the server threads for the GIL and understate every
@@ -298,6 +371,7 @@ def _load_in_subprocess(
             "--concurrency", str(concurrency),
             "--requests", str(n_requests),
             "--query", json.dumps(query),
+            "--client", client,
         ],
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
@@ -353,6 +427,25 @@ def _concurrent_bodies(url: str, concurrency: int, users: int) -> list[bytes]:
     return bodies
 
 
+def _sequential_bodies(url: str, users: int, n: int = 8) -> list[bytes]:
+    """One query at a time (batch size 1 everywhere): across arms these
+    must be BYTE-identical -- no gemv-vs-gemm accumulation drift excuse,
+    because every arm scores the identical batch shape."""
+    import urllib.request
+
+    bodies = []
+    for k in range(n):
+        req = urllib.request.Request(
+            f"{url}/queries.json",
+            data=json.dumps({"user": f"u{k % users}", "num": 10}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            bodies.append(resp.read())
+    return bodies
+
+
 def _measure_arms(
     variant,
     arms: dict[str, dict],
@@ -361,43 +454,62 @@ def _measure_arms(
     query: dict,
     users: int,
     warmup: int,
+    client: str = "http",
 ) -> tuple[dict, dict]:
     """Serve ``variant`` once per arm (``arms`` maps label ->
-    ``create_query_server`` kwargs) and drive the identical concurrent
-    load at each; returns (label -> run_load report, label -> identity
-    probe bodies).
+    ``create_query_server`` kwargs; a ``frontend_workers`` key routes the
+    arm through the multi-process tier instead) and drive the identical
+    concurrent load at each; returns (label -> run_load report, label ->
+    identity probe bodies).
 
     Servers run in-process on ephemeral ports; the load clients run in a
     subprocess. Each arm gets a warm-up pass first (per-bucket jit
     compilation must not land in the measured window) plus a coalescing
     identity probe.
     """
-    from predictionio_tpu.workflow.create_server import create_query_server
+    from predictionio_tpu.workflow.create_server import (
+        create_multiproc_query_server,
+        create_query_server,
+    )
 
     def load_in_subprocess(url: str, n_requests: int) -> dict:
-        return _load_in_subprocess(url, concurrency, n_requests, query)
+        return _load_in_subprocess(
+            url, concurrency, n_requests, query, client=client
+        )
 
     def concurrent_bodies(url: str) -> list[bytes]:
         return _concurrent_bodies(url, concurrency, users)
 
     reports: dict[str, dict] = {}
     responses: dict[str, list[bytes]] = {}
+    sequential: dict[str, list[bytes]] = {}
     for label, server_kwargs in arms.items():
-        thread, service = create_query_server(
-            variant, host="127.0.0.1", port=0, **server_kwargs
-        )
-        thread.start()
-        url = f"http://127.0.0.1:{thread.port}"
+        server_kwargs = dict(server_kwargs)
+        workers = server_kwargs.pop("frontend_workers", 0)
+        if workers:
+            handle, service = create_multiproc_query_server(
+                variant, host="127.0.0.1", port=0, frontend=workers,
+                **server_kwargs,
+            )
+        else:
+            handle, service = create_query_server(
+                variant, host="127.0.0.1", port=0, **server_kwargs
+            )
+        handle.start()
+        url = f"http://127.0.0.1:{handle.port}"
         try:
             # warm-up: compile every batch bucket outside the clock
             load_in_subprocess(url, warmup)
-            # identity probe under coalescing load (outside the clock)
+            # identity probes (outside the clock): sequential = byte
+            # identity at batch size 1, concurrent = scatter check under
+            # coalescing (documented ulp drift across batch shapes)
+            sequential[label] = _sequential_bodies(url, users)
             responses[label] = concurrent_bodies(url)
             reports[label] = load_in_subprocess(url, requests)
         finally:
-            thread.stop()
+            handle.stop()
             service.close()
-    return reports, responses
+    return reports, responses, sequential
 
 
 def run_ab(
@@ -426,7 +538,7 @@ def run_ab(
                 )
             },
         }
-        reports, responses = _measure_arms(
+        reports, responses, _sequential = _measure_arms(
             variant, arms, concurrency, requests,
             {"user": "u1", "num": 10}, sizes["users"],
             warmup=max(4 * max_batch_size, concurrency),
@@ -449,6 +561,130 @@ def run_ab(
     )
     off, on = out["batching_off"]["qps"], out["batching_on"]["qps"]
     out["qps_speedup"] = round(on / off, 2) if off else None
+    return out
+
+
+def _set_blas_threads(n: int) -> "int | None":
+    """Best-effort runtime OpenBLAS thread cap; returns the previous
+    value (to restore) or None when no OpenBLAS is loaded.
+
+    Why the serving A/B caps BLAS at 1: OpenBLAS worker threads
+    BUSY-SPIN between gemms, and on the 2-core box that spin (from the
+    scorer's per-batch factor-matrix gemm) stole whole scheduler quanta
+    from the frontend worker processes -- measured as a 3-8x qps
+    collapse of the process tier with multi-second completion-ring
+    backups. Capped to 1 the gemm runs on the dispatching thread and
+    every process gets scheduled. Applied identically to every arm.
+    """
+    import ctypes
+    import re
+
+    try:
+        with open("/proc/self/maps") as f:
+            paths = sorted({
+                m.group(1)
+                for line in f
+                if (m := re.search(r"(/\S*openblas\S*\.so\S*)", line))
+            })
+        for path in paths:
+            lib = ctypes.CDLL(path)
+            for suffix in ("64_", "64", "_", ""):
+                get = getattr(lib, f"openblas_get_num_threads{suffix}", None)
+                set_ = getattr(lib, f"openblas_set_num_threads{suffix}", None)
+                if get is not None and set_ is not None:
+                    prev = int(get())
+                    set_(int(n))
+                    return prev
+    except Exception:
+        pass
+    return None
+
+
+def run_multiproc_ab(
+    engine: str = "recommendation",
+    concurrency: int = 32,
+    requests: int = 2000,
+    workers: tuple = (1, 2),
+    users: int | None = None,
+    items: int | None = None,
+    events: int | None = None,
+    window_ms: float = 2.0,
+    max_batch_size: int = 64,
+    max_inflight: int | None = None,
+) -> dict:
+    """The multi-process serving A/B: the single-process
+    ``ThreadingHTTPServer`` tier vs N ``SO_REUSEPORT`` frontend workers
+    feeding the shared-memory ring, identical micro-batched scorer and
+    identical concurrent load (raw-socket clients -- the stock
+    ``http.client`` generator saturates around ~600 qps on the 2-core
+    box, below the process tier's ceiling, so it would measure itself).
+    Reports per-arm ``run_load`` stats, per-worker-count speedups, and
+    the coalescing identity probe (bodies must be byte-identical across
+    every arm: all of them are produced by the same scorer router).
+    """
+    from predictionio_tpu.workflow.microbatch import BatchConfig
+
+    from predictionio_tpu.serving.procserver import FrontendConfig
+
+    batching = BatchConfig(window_ms=window_ms, max_batch_size=max_batch_size)
+    arms: dict[str, dict] = {"singleproc": {"batching": batching}}
+    for n in sorted(set(int(w) for w in workers if int(w) > 0)):
+        fe = FrontendConfig(workers=n)
+        if max_inflight is not None:
+            fe.max_inflight = max_inflight
+        arms[f"workers_{n}"] = {
+            "batching": batching, "frontend_workers": fe,
+        }
+    prev_blas = _set_blas_threads(1)
+    try:
+        with _synthetic_deployment(engine, users, items, events) as (variant, sizes):
+            reports, responses, sequential = _measure_arms(
+                variant, arms, concurrency, requests,
+                {"user": "u1", "num": 10}, sizes["users"],
+                warmup=max(4 * max_batch_size, concurrency, 256),
+                client="raw",
+            )
+    finally:
+        if prev_blas is not None:
+            _set_blas_threads(prev_blas)
+    out: dict = {
+        "engine": engine,
+        "concurrency": concurrency,
+        "requests": requests,
+        **sizes,
+        "window_ms": window_ms,
+        "max_batch_size": max_batch_size,
+        **reports,
+    }
+    # batch-size-1 probes: byte identity is REQUIRED across arms (every
+    # arm's body is produced by the same scorer code over the same shape)
+    seq_base = sequential["singleproc"]
+    out["responses_identical"] = all(
+        sequential[label] == seq_base for label in arms
+    )
+    # coalescing probes: scatter correctness; across arms batch
+    # composition is timing-dependent, so scores may carry the
+    # documented ulp-level gemv-vs-gemm accumulation drift
+    base = responses["singleproc"]
+    out["responses_equivalent"] = all(
+        _responses_equivalent(a, b)
+        for label in arms
+        for a, b in zip(base, responses[label])
+    ) and all(
+        _responses_equivalent(a, b)
+        for label in arms
+        for a, b in zip(seq_base, sequential[label])
+    )
+    sp = reports["singleproc"]["qps"]
+    for label in arms:
+        if label == "singleproc" or not sp:
+            continue
+        out[f"qps_speedup_{label}"] = round(reports[label]["qps"] / sp, 2)
+    best = max(
+        (reports[label]["qps"] for label in arms if label != "singleproc"),
+        default=0.0,
+    )
+    out["qps_speedup"] = round(best / sp, 2) if sp else None
     return out
 
 
@@ -600,16 +836,48 @@ def main(argv: list[str] | None = None) -> int:
         help="run the tracing on/off overhead A/B instead of the"
         " batching A/B",
     )
+    ap.add_argument(
+        "--client", choices=("http", "raw"), default="http",
+        help="load-generator flavor for --url mode: http.client (the"
+        " historical baseline client) or a minimal raw-socket client"
+        " (~5x less generator python; use when the server outruns the"
+        " generator)",
+    )
+    ap.add_argument(
+        "--frontend-workers", type=int, default=None, metavar="N",
+        help="run the multi-process serving sweep instead: single-process"
+        " vs SO_REUSEPORT frontend tiers of 1, 2 and N workers",
+    )
     args = ap.parse_args(argv)
     if args.url:
         print(
             json.dumps(
                 run_load(
                     args.url, args.query, args.clients or 8,
-                    args.requests or 400,
+                    args.requests or 400, client=args.client,
                 )
             )
         )
+        return 0
+    if args.frontend_workers is not None:
+        engines = (
+            ["recommendation"] if args.engine == "both" else [args.engine]
+        )
+        report = {
+            name: run_multiproc_ab(
+                name,
+                concurrency=args.clients or 32,
+                requests=args.requests or 2000,
+                workers=(1, 2, args.frontend_workers),
+                users=args.users,
+                items=args.items,
+                events=args.events,
+                window_ms=args.batch_window_ms,
+                max_batch_size=args.max_batch_size,
+            )
+            for name in engines
+        }
+        print(json.dumps(report))
         return 0
     engines = list(AB_ENGINES) if args.engine == "both" else [args.engine]
     ab = run_trace_ab if args.trace_overhead else run_ab

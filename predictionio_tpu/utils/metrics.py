@@ -162,6 +162,66 @@ class MetricsRegistry:
                 row[-2] += value              # sum
                 row[-1] += 1                  # count
 
+    def snapshot(self) -> dict:
+        """JSON-serializable dump of every series -- the cross-process
+        aggregation format. The multi-process serving tier's frontend
+        workers publish these through their ring's stats region; the
+        scorer merges them (``merge_snapshot``) into one ``/metrics``
+        view at scrape time. Label keys ride as ``[[k, v], ...]`` pairs
+        so the dump survives a JSON round-trip."""
+        with self._lock:
+            return {
+                "help": dict(self._help),
+                "counters": [
+                    [name, [list(kv) for kv in key], value]
+                    for name, series in self._counters.items()
+                    for key, value in series.items()
+                ],
+                "gauges": [
+                    [name, [list(kv) for kv in key], value]
+                    for name, series in self._gauges.items()
+                    for key, value in series.items()
+                ],
+                "histograms": [
+                    [name, list(buckets), [list(kv) for kv in key], list(row)]
+                    for name, (buckets, series) in self._histograms.items()
+                    for key, row in series.items()
+                ],
+            }
+
+    def merge_snapshot(self, snap: dict) -> None:
+        """Fold a ``snapshot()`` dump into this registry: counters and
+        histogram rows ADD (sum across workers), gauges SET (last writer
+        wins -- point-in-time values don't sum meaningfully across
+        label-identical series; per-worker gauges carry a ``worker``
+        label precisely so they never collide). A histogram whose bucket
+        spec disagrees with an existing series is rejected loudly --
+        silent bucket mixing would corrupt every quantile downstream."""
+        with self._lock:
+            for name, text in (snap.get("help") or {}).items():
+                self._help.setdefault(name, text)
+            for name, key, value in snap.get("counters") or ():
+                key = tuple(tuple(kv) for kv in key)
+                series = self._counters.setdefault(name, {})
+                series[key] = series.get(key, 0.0) + float(value)
+            for name, key, value in snap.get("gauges") or ():
+                key = tuple(tuple(kv) for kv in key)
+                self._gauges.setdefault(name, {})[key] = float(value)
+            for name, buckets, key, row in snap.get("histograms") or ():
+                key = tuple(tuple(kv) for kv in key)
+                bucket_spec, series = self._histograms.setdefault(
+                    name, (tuple(buckets), {})
+                )
+                if tuple(buckets) != bucket_spec:
+                    raise ValueError(
+                        f"histogram {name!r}: bucket spec mismatch in merge"
+                    )
+                mine = series.setdefault(
+                    key, [0] * (len(bucket_spec) + 1) + [0.0, 0]
+                )
+                for i, v in enumerate(row):
+                    mine[i] += v
+
     def exposition(self) -> str:
         lines: list[str] = []
         with self._lock:

@@ -100,6 +100,7 @@ class QueryService:
         tracing: bool | None = None,
         trace_sample: float | None = None,
         slow_query_ms: float | None = None,
+        extra_metrics_snapshots=None,
     ):
         self.variant = variant
         self.engine = engine or build_engine(variant)
@@ -107,6 +108,9 @@ class QueryService:
         self.feedback = feedback
         self.plugins = list(plugins or [])
         self.batching = BatchConfig() if batching is None else batching
+        #: set by the multi-process tier: {"workers": N, ...} for the info
+        #: page (``pio top``/operators see the process model at a glance)
+        self.frontend_info: dict | None = None
         self._lock = threading.RLock()
         self._served = 0
         self._started = _dt.datetime.now(_dt.timezone.utc)
@@ -121,9 +125,16 @@ class QueryService:
                 "pio_queries_served_total", served,
                 help="Queries answered successfully",
             )
+            if self._batcher is not None:
+                registry.set_gauge(
+                    "pio_serving_queue_depth", self._batcher.depth(),
+                    help="Queries waiting in the micro-batcher queue",
+                )
 
         self.router, self.metrics = instrumented_router(
-            before_scrape=mirror, tracing=tracing, trace_sample=trace_sample
+            before_scrape=mirror, tracing=tracing,
+            trace_sample=trace_sample,
+            extra_snapshots=extra_metrics_snapshots,
         )
         if slow_query_ms is not None:
             # one summary log line per query trace over the threshold
@@ -182,26 +193,26 @@ class QueryService:
     # -- handlers -----------------------------------------------------------
     def handle_info(self, request: Request) -> Response:
         with self._lock:
-            return Response(
-                200,
-                {
-                    "status": "alive",
-                    "engineInstance": {
-                        "id": self.instance.id,
-                        "engineVariant": self.variant.variant_id,
-                        "startTime": self.instance.start_time.isoformat(),
-                    },
-                    "algorithms": [type(a).__name__ for a in self.algorithms],
-                    "startTime": self._started.isoformat(),
-                    "serverStats": {"queryCount": self._served},
-                    "batching": {
-                        "enabled": self._batcher is not None,
-                        "maxBatchSize": self.batching.max_batch_size,
-                        "windowMs": self.batching.window_ms,
-                        "buckets": list(self.batching.buckets),
-                    },
+            body = {
+                "status": "alive",
+                "engineInstance": {
+                    "id": self.instance.id,
+                    "engineVariant": self.variant.variant_id,
+                    "startTime": self.instance.start_time.isoformat(),
                 },
-            )
+                "algorithms": [type(a).__name__ for a in self.algorithms],
+                "startTime": self._started.isoformat(),
+                "serverStats": {"queryCount": self._served},
+                "batching": {
+                    "enabled": self._batcher is not None,
+                    "maxBatchSize": self.batching.max_batch_size,
+                    "windowMs": self.batching.window_ms,
+                    "buckets": list(self.batching.buckets),
+                },
+            }
+            if self.frontend_info is not None:
+                body["frontend"] = self.frontend_info
+            return Response(200, body)
 
     def _predict_one(self, query_obj) -> Any:
         """The unbatched predict -> serve chain for one raw query dict."""
@@ -405,10 +416,107 @@ def create_query_server(
     return ServiceThread(server), service
 
 
+class MultiprocServiceHandle:
+    """The multi-process analogue of :class:`ServiceThread`: same
+    ``start()/stop()/port`` surface, so benches and tests treat both
+    tiers uniformly. ``stop()`` drains the frontends (in-flight requests
+    are answered) before the scorer bridge tears down."""
+
+    def __init__(self, bridge, service: QueryService):
+        self.bridge = bridge
+        self.service = service
+
+    @property
+    def port(self) -> int:
+        return self.bridge.port
+
+    def start(self) -> "MultiprocServiceHandle":
+        self.bridge.start()
+        return self
+
+    def stop(self) -> None:
+        self.bridge.stop()
+
+
+def create_multiproc_query_server(
+    variant: EngineVariant,
+    host: str = "0.0.0.0",
+    port: int = DEFAULT_PORT,
+    frontend=None,
+    **service_kwargs,
+) -> tuple[MultiprocServiceHandle, QueryService]:
+    """The multi-process serving tier: this process becomes the scorer
+    (models + micro-batcher + router, exactly the single-process
+    ``QueryService``); ``frontend`` (a ``FrontendConfig`` or a worker
+    count) sizes the ``SO_REUSEPORT`` frontend processes that do the
+    HTTP. Responses are byte-identical to the single-process server
+    because every body is produced by the same router in the scorer.
+
+    TLS is not supported at the frontend tier (terminate it in front, or
+    deploy single-process with ``--ssl-cert``).
+    """
+    from predictionio_tpu.serving.procserver import FrontendConfig, ScorerBridge
+
+    if service_kwargs.pop("ssl_cert", None) or service_kwargs.pop("ssl_key", None):
+        raise ValueError(
+            "--frontend-workers does not support --ssl-cert/--ssl-key; "
+            "terminate TLS in front of the frontend tier"
+        )
+    if isinstance(frontend, int):
+        frontend = FrontendConfig(workers=frontend)
+    frontend = frontend or FrontendConfig()
+    # the bridge exists only after the service (it needs the router), but
+    # the service's /metrics hook needs the bridge: late-bind via a cell
+    bridge_cell: list = []
+
+    def worker_snapshots() -> list[dict]:
+        return bridge_cell[0].metric_snapshots() if bridge_cell else []
+
+    service = QueryService(
+        variant, extra_metrics_snapshots=worker_snapshots, **service_kwargs
+    )
+    bridge = ScorerBridge(
+        service.router, host, port, frontend, registry=service.metrics
+    )
+    bridge_cell.append(bridge)
+    service.frontend_info = frontend.describe()
+    return MultiprocServiceHandle(bridge, service), service
+
+
 def run_query_server(
-    variant: EngineVariant, host: str = "0.0.0.0", port: int = DEFAULT_PORT, **kw
+    variant: EngineVariant,
+    host: str = "0.0.0.0",
+    port: int = DEFAULT_PORT,
+    frontend_workers: int = 0,
+    frontend=None,
+    **kw,
 ) -> None:
-    """Blocking entry point used by ``pio deploy``."""
+    """Blocking entry point used by ``pio deploy``. With
+    ``frontend_workers`` > 0 (or an explicit ``frontend`` config) the
+    server runs as the multi-process tier: N ``SO_REUSEPORT`` frontend
+    processes feeding this process's scorer through shared-memory rings.
+    """
+    if frontend_workers or frontend is not None:
+        from predictionio_tpu.serving.procserver import FrontendConfig
+
+        if frontend is None:
+            frontend = FrontendConfig(workers=frontend_workers)
+        handle, service = create_multiproc_query_server(
+            variant, host, port, frontend=frontend, **kw
+        )
+        handle.start()
+        print(
+            f"Query Server listening on http://{host}:{handle.port}"
+            f" ({frontend.workers} frontend worker(s),"
+            f" engine instance {service.instance.id})"
+        )
+        try:
+            service._stop_event.wait()
+        except KeyboardInterrupt:
+            pass
+        handle.stop()   # frontends drain first (in-flight answered) ...
+        service.close()  # ... then the micro-batcher flushes
+        return
     thread, service = create_query_server(variant, host, port, **kw)
     scheme = "https" if kw.get("ssl_cert") else "http"
     thread.start()

@@ -179,6 +179,13 @@ class MicroBatcher:
             self._queue.put_nowait(item)
         return item.future
 
+    def depth(self) -> int:
+        """Approximate queries waiting for a flush -- the serving-tier
+        backlog gauge (``pio_serving_queue_depth``) mirrored into
+        ``/metrics`` at scrape time. Approximate by design: ``qsize`` is
+        racy, and a gauge read between enqueue and flush needs no lock."""
+        return self._queue.qsize()
+
     def close(self) -> None:
         """Stop accepting queries, flush everything in flight, join the
         flusher. Idempotent; safe to call from any thread."""

@@ -23,6 +23,7 @@ from predictionio_tpu.analysis.rules_concurrency import (
     RuleC001,
     RuleC002,
     RuleC003,
+    RuleC004,
 )
 from predictionio_tpu.analysis.rules_jax import (
     RuleJ001,
@@ -605,6 +606,109 @@ class TestC003:
         assert [f.rule_id for f in hits] == ["C003"]
 
 
+# -- C004: fork-after-threads / state inherited across fork -------------------
+
+class TestC004:
+    def test_fires_on_os_fork(self):
+        hits = run_rule(RuleC004, """
+            import os
+
+            def daemonize():
+                if os.fork():
+                    raise SystemExit(0)
+        """)
+        assert [f.rule_id for f in hits] == ["C004"]
+        assert "os.fork" in hits[0].message
+
+    def test_fires_on_fork_start_method_and_context(self):
+        hits = run_rule(RuleC004, """
+            import multiprocessing
+
+            def setup():
+                multiprocessing.set_start_method("fork")
+                return multiprocessing.get_context("fork")
+        """)
+        assert [f.rule_id for f in hits] == ["C004", "C004"]
+        assert all("fork" in f.message for f in hits)
+
+    def test_fires_on_default_context_process(self):
+        # bare Process = platform default = fork on Linux: the exact
+        # hazard (a batcher flusher's held lock forked into the child)
+        hits = run_rule(RuleC004, """
+            import multiprocessing
+
+            def launch(target):
+                p = multiprocessing.Process(target=target)
+                p.start()
+                return p
+        """)
+        assert [f.rule_id for f in hits] == ["C004"]
+        assert "platform-default" in hits[0].message
+
+    def test_fires_on_from_import_process(self):
+        hits = run_rule(RuleC004, """
+            from multiprocessing import Process
+
+            def launch(target):
+                return Process(target=target)
+        """)
+        assert [f.rule_id for f in hits] == ["C004"]
+
+    def test_fires_on_aliased_process_import(self):
+        # `import Process as P` must not dodge the rule
+        hits = run_rule(RuleC004, """
+            from multiprocessing import Process as P
+
+            def launch(target):
+                return P(target=target)
+        """)
+        assert [f.rule_id for f in hits] == ["C004"]
+
+    def test_fires_on_lock_handed_to_child(self):
+        # even under spawn, lock/registry state handed across the process
+        # boundary diverges silently -- flagged as its own finding
+        hits = run_rule(RuleC004, """
+            import multiprocessing
+
+            class S:
+                def launch(self):
+                    ctx = multiprocessing.get_context("spawn")
+                    return ctx.Process(
+                        target=work, args=(self._lock, self.registry)
+                    )
+        """)
+        assert [f.rule_id for f in hits] == ["C004"]
+        assert "process boundary" in hits[0].message
+
+    def test_silent_on_spawn_context_and_subprocess(self):
+        # the repo's real fix shapes: subprocess.Popen (fresh interpreter,
+        # state handed over as fds/paths) and an explicit spawn context
+        assert run_rule(RuleC004, """
+            import subprocess
+            import sys
+            import multiprocessing
+
+            def launch(cmd, fds):
+                ctx = multiprocessing.get_context("spawn")
+                p1 = ctx.Process(target=entry, args=("/ring/path", 7))
+                p2 = subprocess.Popen(
+                    [sys.executable, "-m", "mod"], pass_fds=fds
+                )
+                return p1, p2
+        """) == []
+
+    def test_silent_on_unrelated_process_name(self):
+        # a local class named Process with no multiprocessing import must
+        # not fire (bounded false positives)
+        assert run_rule(RuleC004, """
+            class Process:
+                pass
+
+            def launch():
+                return Process()
+        """) == []
+
+
 # -- lockwatch: runtime C001 --------------------------------------------------
 
 class TestLockwatch:
@@ -714,7 +818,11 @@ def test_repo_wide_zero_unsuppressed_findings():
     unsuppressed, _, stale = apply_baseline(findings, load_baseline())
     assert unsuppressed == [], "\n".join(f.render() for f in unsuppressed)
     assert stale == [], f"stale baseline entries: {stale}"
-    assert elapsed < 10.0, f"pio check took {elapsed:.1f}s (budget 10s)"
+    # budget raised 10s -> 15s in PR 8: the package grew (obs/, serving/)
+    # and C004 joined the sweep; a full run measures ~5s solo on the
+    # 2-core box, and the old budget left too little margin against
+    # co-tenant noise (observed 10.6s purely from box contention)
+    assert elapsed < 15.0, f"pio check took {elapsed:.1f}s (budget 15s)"
 
 
 def test_cli_check_json(capsys):

@@ -40,6 +40,59 @@ class TestRegistry:
         m.inc("c_total", {"q": 'say "hi"\\now'})
         assert 'q="say \\"hi\\"\\\\now"' in m.exposition()
 
+    def test_snapshot_merge_roundtrip_sums_counters_and_histograms(self):
+        """The multi-process aggregation path: worker registries dump via
+        snapshot() (JSON round-trip, as the ring stats region carries
+        them) and merge_snapshot() SUMS counters/histogram rows while
+        gauges are last-write-wins."""
+        workers = []
+        for k in range(2):
+            w = MetricsRegistry()
+            w.inc("fw_req_total", {"worker": str(k)}, amount=5 + k, help="fw")
+            w.inc("fw_shared_total", amount=2.0)
+            w.set_gauge("fw_depth", 3.0 + k, {"worker": str(k)})
+            w.observe("fw_lat", 0.002, buckets=(0.001, 0.01))
+            workers.append(w)
+        scorer = MetricsRegistry()
+        scorer.inc("scorer_total", amount=7)
+        merged = MetricsRegistry()
+        merged.merge_snapshot(scorer.snapshot())
+        for w in workers:
+            # the ring carries JSON: tuples must survive the round-trip
+            merged.merge_snapshot(json.loads(json.dumps(w.snapshot())))
+        text = merged.exposition()
+        assert 'fw_req_total{worker="0"} 5' in text
+        assert 'fw_req_total{worker="1"} 6' in text
+        assert "fw_shared_total 4" in text          # summed across workers
+        assert 'fw_depth{worker="0"} 3' in text     # gauges kept per label
+        assert 'fw_depth{worker="1"} 4' in text
+        assert "scorer_total 7" in text
+        assert 'fw_lat_bucket{le="0.01"} 2' in text  # rows added elementwise
+        assert "fw_lat_count 2" in text
+        assert "# HELP fw_req_total fw" in text     # help rides the snapshot
+
+    def test_merge_rejects_mismatched_histogram_buckets(self):
+        a = MetricsRegistry()
+        a.observe("h", 0.5, buckets=(0.1, 1.0))
+        b = MetricsRegistry()
+        b.observe("h", 0.5, buckets=(0.2, 2.0))
+        a_snap = b.snapshot()
+        with pytest.raises(ValueError, match="bucket spec mismatch"):
+            a.merge_snapshot(a_snap)
+
+    def test_merge_is_additive_across_repeated_scrapes(self):
+        """Each scrape builds a FRESH merged view, so merging the same
+        worker snapshot twice into one registry double-counts -- the
+        exposition path must therefore never reuse a merge target (this
+        pins the contract the instrumented_router hook relies on)."""
+        w = MetricsRegistry()
+        w.inc("c_total", amount=3)
+        snap = w.snapshot()
+        merged = MetricsRegistry()
+        merged.merge_snapshot(snap)
+        merged.merge_snapshot(snap)
+        assert "c_total 6" in merged.exposition()
+
     def test_default_buckets_cover_sub_ms_to_slow(self):
         assert DEFAULT_BUCKETS[0] <= 0.0005 and DEFAULT_BUCKETS[-1] >= 10
 

@@ -1103,6 +1103,42 @@ pio_serving_batch_size_count %d
             "traces": None,
         }
 
+    def test_frontend_worker_stats_and_render(self):
+        """The multi-process tier's aggregated series reach the `pio top`
+        view: worker count in the WKR column, frontend qps from the
+        per-worker counter deltas, and the serving queue gauge folded
+        into QUEUE."""
+        from predictionio_tpu.obs.top import (
+            compute_stats,
+            parse_prometheus,
+            render,
+        )
+
+        tmpl = (
+            "pio_frontend_workers 2\n"
+            'pio_frontend_requests_total{status="2xx",worker="0"} %d\n'
+            'pio_frontend_requests_total{status="2xx",worker="1"} %d\n'
+            "pio_serving_queue_depth 3\n"
+        )
+
+        def snap(t, a, b):
+            return {
+                "url": "http://x:1",
+                "time": t,
+                "metrics": parse_prometheus(tmpl % (a, b)),
+                "traces": None,
+            }
+
+        stats = compute_stats(snap(100.0, 100, 50), snap(102.0, 200, 150))
+        assert stats["frontend_workers"] == 2
+        # (100 + 100) forwarded requests over 2 s, summed across workers
+        assert stats["frontend_qps"] == pytest.approx(100.0)
+        assert stats["ingest_queue_depth"] == 3
+        frame = render([stats], [snap(102.0, 200, 150)])
+        assert "WKR" in frame
+        row = next(l for l in frame.splitlines() if "http://x:1" in l)
+        assert row.rstrip().endswith("2")
+
     def test_parse_prometheus(self):
         from predictionio_tpu.obs.top import parse_prometheus
 
@@ -1335,4 +1371,144 @@ class TestQueryServerTracing:
                 assert len(exec_ids) == 1
         finally:
             thread.stop()
+            service.close()
+
+    def test_traceparent_survives_the_frontend_ring(
+        self, storage_env, tmp_path
+    ):
+        """Multi-process regression: a traceparent'd query enters through
+        an SO_REUSEPORT frontend process, crosses the shared-memory ring,
+        and its queue-wait/assemble/execute spans still land in the
+        ORIGINAL trace -- plus a ``frontend.ring_wait`` span stitched
+        from the frontend's clock across the process boundary. Two
+        coalesced queries keep sharing one batch-level span id exactly as
+        in the single-process tier."""
+        import os
+        import sys
+
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.workflow.core_workflow import run_train
+        from predictionio_tpu.workflow.create_server import (
+            create_multiproc_query_server,
+        )
+        from predictionio_tpu.workflow.json_extractor import (
+            load_engine_variant,
+        )
+        from predictionio_tpu.workflow.microbatch import BatchConfig
+
+        tests_dir = os.path.dirname(os.path.abspath(__file__))
+        if tests_dir not in sys.path:
+            sys.path.insert(0, tests_dir)
+        app_id = storage_env.get_meta_data_apps().insert(
+            App(name="RingTraceApp")
+        )
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        le.batch_insert(
+            [
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{k % 4}",
+                    target_entity_type="item", target_entity_id=f"i{k}",
+                    properties=DataMap({"rating": float(1 + k % 5)}),
+                )
+                for k in range(20)
+            ],
+            app_id=app_id,
+        )
+        variant_path = tmp_path / "engine.json"
+        variant_path.write_text(json.dumps({
+            "id": "default",
+            "engineFactory": "fake_engine.engine_factory",
+            "datasource": {"params": {"appName": "RingTraceApp"}},
+            "algorithms": [{"name": "mean", "params": {}}],
+        }))
+        variant = load_engine_variant(str(variant_path))
+        run_train(variant)
+        handle, service = create_multiproc_query_server(
+            variant, host="127.0.0.1", port=0, frontend=2, tracing=True,
+            batching=BatchConfig(window_ms=100, idle_ms=50, max_batch_size=4),
+        )
+        handle.start()
+        url = f"http://127.0.0.1:{handle.port}"
+        try:
+            trace_ids = ["3a" * 16, "4b" * 16]
+            results = [None, None]
+
+            def worker(k):
+                req = urllib.request.Request(
+                    f"{url}/queries.json",
+                    data=json.dumps({"user": f"u{k}", "num": 3}).encode(),
+                    headers={
+                        "Content-Type": "application/json",
+                        "traceparent": format_traceparent(
+                            trace_ids[k], "cc" * 8
+                        ),
+                    },
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    results[k] = (
+                        resp.status, resp.headers.get("traceparent")
+                    )
+
+            threads = [
+                threading.Thread(target=worker, args=(k,)) for k in (0, 1)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for k, (status, tp_out) in enumerate(results):
+                assert status == 200
+                # the response traceparent rode the ring back out and
+                # still joins the CLIENT's trace
+                assert parse_traceparent(tp_out)[0] == trace_ids[k]
+            snap = _get_json(f"{url}/traces.json?limit=100")
+            traces = {t["traceId"]: t for t in snap["recent"]}
+            for tid in trace_ids:
+                assert tid in traces, (
+                    f"client trace {tid} missing from the scorer's "
+                    f"retention: {sorted(traces)}"
+                )
+                spans = traces[tid]["spans"]
+                ops = [s["op"] for s in spans]
+                for expected in (
+                    "frontend.ring_wait", "query.parse",
+                    "batch.queue_wait", "batch.assemble", "batch.execute",
+                    "query.respond",
+                ):
+                    assert expected in ops, f"{expected} missing from {ops}"
+                assert traces[tid]["op"] == "POST /queries.json"
+                ring_span = next(
+                    s for s in spans if s["op"] == "frontend.ring_wait"
+                )
+                # stitched from the frontend process's perf_counter: a
+                # sane non-negative duration and the worker's identity
+                assert ring_span["durationMs"] >= 0.0
+                assert ring_span["attrs"]["worker"] in ("0", "1")
+            exec_ids = {
+                next(
+                    s["spanId"]
+                    for s in traces[tid]["spans"]
+                    if s["op"] == "batch.execute"
+                )
+                for tid in trace_ids
+            }
+            if len(exec_ids) == 2:
+                # the wave did not coalesce (scheduling); per-trace spans
+                # must still be complete with their batch metadata
+                sizes = {
+                    next(
+                        s["attrs"]["batch_size"]
+                        for s in traces[tid]["spans"]
+                        if s["op"] == "batch.execute"
+                    )
+                    for tid in trace_ids
+                }
+                assert sizes
+            else:
+                assert len(exec_ids) == 1
+        finally:
+            handle.stop()
             service.close()
