@@ -581,6 +581,39 @@ def secondary_main(result_path: str) -> None:
             "config": "#10 analysis_findings (pio check --format json)",
         }
 
+    def online_freshness():
+        """#13: continuous-learning freshness -- the wall seconds between
+        a durable ingest and the first /queries.json response reflecting
+        it, under concurrent serving load, fold-in loop vs the same loop
+        forced to full retrains (`pio retrain --follow` A/B). CPU-only
+        like serving_qps (the serving+fold path is host+single-chip).
+        Full-size knobs: `python -m predictionio_tpu.tools.retrain_bench`.
+        """
+        if tpu:
+            return {
+                "skipped": "CPU-only phase (TPU child shares an already-"
+                "initialized backend)"
+            }
+        from predictionio_tpu.tools.retrain_bench import run_ab
+
+        rep = run_ab(
+            events=1_500, users=50, items=25, rank=8, iterations=2,
+            probes=3, load_clients=2,
+        )
+        full = rep.get("full_retrain") or {}
+        return {
+            "online_freshness_seconds": rep["foldin"]["freshness_s_median"],
+            "online_freshness_seconds_max": rep["foldin"]["freshness_s_max"],
+            "full_retrain_freshness_seconds": full.get("freshness_s_median"),
+            "foldin_speedup": rep.get("foldin_speedup"),
+            "probe_timeouts": rep["foldin"]["timeouts"]
+            + full.get("timeouts", 0),
+            "load_errors": rep["foldin"]["load_errors"]
+            + full.get("load_errors", 0),
+            "config": "#13 online_freshness (3 probes, 2 load clients,"
+            " sqlite, rank 8)",
+        }
+
     phase("naive_bayes_fit", nb_fit)
     phase("logreg_lbfgs_fit", logreg_fit)
     phase("cooccurrence_llr_indicators", cooc_indicators)
@@ -592,6 +625,7 @@ def secondary_main(result_path: str) -> None:
     phase("trace_overhead_pct", trace_overhead_pct)
     phase("serving_qps_multiproc", serving_qps_multiproc)
     phase("analysis_findings", analysis_findings)
+    phase("online_freshness_seconds", online_freshness)
 
 
 def child_main(mode: str, result_path: str) -> None:

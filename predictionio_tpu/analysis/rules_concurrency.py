@@ -23,6 +23,12 @@ C003_SCOPE = (
     "utils/metrics.py",
     "serving/frontend.py",
     "serving/procserver.py",
+    # PR 9: the continuous-learning subsystem -- the loop's state is read
+    # by its follow thread and the query server's swap handlers
+    "online/follower.py",
+    "online/foldin.py",
+    "online/registry.py",
+    "online/loop.py",
 )
 
 _LOCK_CTORS = {

@@ -94,6 +94,14 @@ class DataSource(Component, Generic[TD, Q, A]):
             "evaluation is unavailable for this engine"
         )
 
+    def online_handle(self):
+        """Describe this datasource's interaction scan for the
+        continuous-learning loop (``pio retrain --follow``): a
+        ``models._streaming.StreamingHandle``-shaped object carrying
+        app/channel/event-name/rating-key identity, or None (default) when
+        the datasource cannot be followed online."""
+        return None
+
 
 class Preparator(Component, Generic[TD, PD]):
     @abc.abstractmethod
@@ -116,8 +124,22 @@ class Algorithm(Component, Generic[PD, M, Q, P]):
 
     persist_model: bool = True
 
+    #: True when :meth:`fold_in` is implemented -- the continuous-learning
+    #: loop escalates to a full retrain for algorithms that are not
+    supports_fold_in: bool = False
+
     @abc.abstractmethod
     def train(self, ctx, prepared_data: PD) -> M: ...
+
+    def fold_in(self, model: M, delta) -> M | None:
+        """Incrementally absorb a delta window (``online.foldin.
+        FoldinDelta``) into ``model``, returning a NEW model (the swap
+        protocol needs immutability -- never mutate the argument) or None
+        when the window holds nothing to absorb. May raise
+        ``online.foldin.StalenessExceeded`` to demand a full retrain."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement fold_in"
+        )
 
     @abc.abstractmethod
     def predict(self, model: M, query: Q) -> P: ...

@@ -103,6 +103,12 @@ def _wal_parse(payload: bytes) -> tuple[Event, int, int | None, str | None]:
     return Event.from_json_obj(obj["e"]), obj["a"], obj["c"], obj.get("t")
 
 
+#: public names for the frame codec: the continuous-learning WAL tail
+#: (``online.follower``) parses the same records from another process
+wal_payload = _wal_payload
+wal_parse = _wal_parse
+
+
 class IngestPipeline:
     """Single-writer group-commit pipeline in front of ``LEvents``.
 

@@ -102,6 +102,72 @@ def _valid_prefix_length(path: str) -> int:
     return end
 
 
+def read_checkpoint(directory: str) -> int:
+    """Last seqno known flushed to storage, read straight off disk (0 when
+    absent/unreadable). The continuous-learning follower polls this from a
+    DIFFERENT process than the ingest writer: a record is only safe to act
+    on once it is in the event store (the ack point is the WAL, but the
+    snapshot refresh scans SQL), so the follower bounds its tail at the
+    storage high-water mark, not at the append head."""
+    try:
+        with open(os.path.join(directory, _CHECKPOINT_FILE)) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def oldest_seqno(directory: str) -> int | None:
+    """First seqno of the oldest retained segment (None = empty log). A
+    cross-process tail whose cursor trails this has a GC gap: records it
+    never saw were collected after their storage flush, so it must
+    resynchronize from the event store instead of the log."""
+    firsts = []
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return None
+    for name in entries:
+        first = _segment_first_seqno(name)
+        if first is not None:
+            firsts.append(first)
+    return min(firsts) if firsts else None
+
+
+def iter_log_records(
+    directory: str, after_seqno: int = 0, upto_seqno: int | None = None
+):
+    """Yield ``(seqno, payload)`` for intact records with ``after_seqno <
+    seqno <= upto_seqno`` in seqno order, reading the segment files
+    directly (no :class:`WriteAheadLog` instance, no locks -- safe from a
+    follower process while the owning writer keeps appending: frames are
+    published by a single sequential write and the CRC scan stops at the
+    first torn tail). Segments whose entire range is below ``after_seqno``
+    are skipped via the layout invariant (a segment's name is its first
+    record's seqno)."""
+    names = []
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return
+    for name in entries:
+        if _segment_first_seqno(name) is not None:
+            names.append(name)
+    names.sort()
+    firsts = [_segment_first_seqno(n) for n in names]
+    for i, name in enumerate(names):
+        # every record in segment i has seqno < firsts[i + 1]
+        if i + 1 < len(names) and firsts[i + 1] - 1 <= after_seqno:
+            continue
+        if upto_seqno is not None and firsts[i] > upto_seqno:
+            return
+        for seqno, payload in _scan_segment(os.path.join(directory, name)):
+            if seqno <= after_seqno:
+                continue
+            if upto_seqno is not None and seqno > upto_seqno:
+                return
+            yield seqno, payload
+
+
 class WriteAheadLog:
     """Thread-safe via an internal lock; the ingest pipeline is the single
     writer in practice, but replay/checkpoint may come from other threads."""
@@ -243,11 +309,9 @@ class WriteAheadLog:
 
     # -- checkpoint / replay --------------------------------------------------
     def _read_checkpoint(self) -> int:
-        try:
-            with open(os.path.join(self.directory, _CHECKPOINT_FILE)) as f:
-                return int(f.read().strip() or 0)
-        except (OSError, ValueError):
-            return 0
+        # ONE definition of the checkpoint file format (the follower's
+        # cross-process read shares it)
+        return read_checkpoint(self.directory)
 
     def committed(self) -> int:
         """Last seqno known flushed to storage (0 = nothing)."""

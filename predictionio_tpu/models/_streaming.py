@@ -68,16 +68,18 @@ class StreamingHandle(SanityCheck):
             raise ValueError(self.empty_message)
 
 
-def streaming_handle_or_none(
+def build_streaming_handle(
     params,
     default_event_names: list[str],
     probe_primary_only: bool = False,
     empty_message: str | None = None,
-) -> StreamingHandle | None:
-    """The shared ``read_training`` branch: a StreamingHandle when the
-    datasource params opt in (``"reader": "streaming"``), else None."""
-    if params.get_or("reader", "materialized") != "streaming":
-        return None
+) -> StreamingHandle:
+    """Build the scan descriptor a datasource's params pin down --
+    unconditionally. ``streaming_handle_or_none`` gates it on the
+    ``"reader": "streaming"`` opt-in for training; the continuous-learning
+    loop (``DataSource.online_handle``) builds one regardless, because the
+    handle is also the identity of the snapshot the loop refreshes and the
+    WAL filter it follows."""
     from predictionio_tpu.data.store import resolve_app_channel
 
     event_names = params.get_or("eventNames", default_event_names)
@@ -95,6 +97,21 @@ def streaming_handle_or_none(
         probe_event_names=[event_names[0]] if probe_primary_only else None,
         empty_message=empty_message
         or "no events found -- check appName and eventNames",
+    )
+
+
+def streaming_handle_or_none(
+    params,
+    default_event_names: list[str],
+    probe_primary_only: bool = False,
+    empty_message: str | None = None,
+) -> StreamingHandle | None:
+    """The shared ``read_training`` branch: a StreamingHandle when the
+    datasource params opt in (``"reader": "streaming"``), else None."""
+    if params.get_or("reader", "materialized") != "streaming":
+        return None
+    return build_streaming_handle(
+        params, default_event_names, probe_primary_only, empty_message
     )
 
 

@@ -46,6 +46,7 @@ from predictionio_tpu.models._als_common import (
 )
 from predictionio_tpu.models._streaming import (
     StreamingHandle,
+    build_streaming_handle,
     streaming_handle_or_none,
 )
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
@@ -145,6 +146,19 @@ class ECommerceDataSource(DataSource):
             )
             return handle
         return self._read()
+
+    def online_handle(self):
+        """Continuous-learning scan descriptor; the confidence map rides
+        ``extras`` exactly like the streaming-training handle, so fold-in
+        weighs a buy the same way training does."""
+        handle = build_streaming_handle(
+            self.params, ["view", "buy"],
+            empty_message="no view/buy events found -- check appName",
+        )
+        handle.extras["event_values"] = _buy_confidences(
+            self.params, handle.event_names
+        )
+        return handle
 
     def read_eval(self, ctx):
         """Hold out each user's latest interaction as the actual."""
@@ -311,6 +325,45 @@ class ECommAlgorithm(TPUAlgorithm):
             seen_mode="live" if streamed else "model",
             channel_name=getattr(data, "channel_name", None),
             event_names=getattr(data, "event_names", None),
+        )
+
+    supports_fold_in = True
+
+    def fold_in(self, model: ECommerceModel, delta) -> ECommerceModel | None:
+        """Continuous-learning hook: implicit fold-in of the delta window
+        (frozen item factors, per-event confidences from the datasource's
+        map riding ``delta.extras``). New items carry zero factors AND no
+        category entries until the next full retrain (categories come from
+        a ``$set`` aggregate the loop does not rescan) -- the staleness
+        budget's item-growth bound caps both forms of staleness at once."""
+        from predictionio_tpu.online.foldin import fold_in_als_model
+
+        event_values = delta.extras.get("event_values") or {}
+        result = fold_in_als_model(
+            model.als,
+            model.user_index,
+            model.item_ids,
+            model.item_index,
+            delta,
+            self._config(),
+            event_values=event_values,
+        )
+        if result is None:
+            return None
+        seen = model.seen
+        if getattr(model, "seen_mode", "model") == "model" and result.window_pairs is not None:
+            seen = {u: set(s) for u, s in model.seen.items()}
+            for u, i in result.window_pairs.tolist():
+                seen.setdefault(int(u), set()).add(int(i))
+        import dataclasses
+
+        return dataclasses.replace(
+            model,
+            als=result.als,
+            user_index=result.user_index,
+            item_ids=result.item_ids,
+            item_index=result.item_index,
+            seen=seen,
         )
 
     # ------------------------------------------------------------------

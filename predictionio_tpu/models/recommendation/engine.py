@@ -33,6 +33,7 @@ from predictionio_tpu.models._als_common import (
 )
 from predictionio_tpu.models._streaming import (
     StreamingHandle,
+    build_streaming_handle,
     streaming_handle_or_none,
 )
 from predictionio_tpu.parallel.als import ALSConfig, ALSModel
@@ -121,6 +122,16 @@ class RecommendationDataSource(DataSource):
             "eventNames",
         )
         return handle if handle is not None else self._read()
+
+    def online_handle(self):
+        """The continuous-learning loop's scan descriptor: same identity
+        (app/channel/event names/rating key) as the training read, so the
+        snapshot the loop refreshes is the one training replays."""
+        return build_streaming_handle(
+            self.params, ["rate", "buy"],
+            empty_message="no rating events found -- check appName and "
+            "eventNames",
+        )
 
     def read_eval(self, ctx):
         """Time-ordered k-fold: hold out each fold's interactions as
@@ -332,6 +343,47 @@ class ALSAlgorithm(TPUAlgorithm):
 
     def warm_up(self, model: RecommendationModel) -> None:
         model.als.item_norms  # build the similar-items norm cache at deploy
+
+    supports_fold_in = True
+
+    def fold_in(self, model: RecommendationModel, delta) -> RecommendationModel | None:
+        """Continuous-learning hook (``pio retrain --follow``): re-solve
+        the delta window's touched user rows against the frozen item
+        factors (``online.foldin``), extend vocabularies for new
+        users/items (new items carry zero factors until the next full
+        retrain -- the staleness budget bounds how long that lasts), and
+        absorb the window into a trained-in seen map. Returns a NEW model;
+        the serving swap protocol relies on the old one staying intact."""
+        from predictionio_tpu.online.foldin import fold_in_als_model
+
+        result = fold_in_als_model(
+            model.als,
+            model.user_index,
+            model.item_ids,
+            model.item_index,
+            delta,
+            self._config(),
+            # the training read scores property-less events 1.0
+            rating_default=1.0,
+        )
+        if result is None:
+            return None
+        seen = model.seen
+        if getattr(model, "seen_mode", "model") == "model" and result.window_pairs is not None:
+            seen = {u: set(s) for u, s in model.seen.items()}
+            for u, i in result.window_pairs.tolist():
+                seen.setdefault(int(u), set()).add(int(i))
+        return RecommendationModel(
+            als=result.als,
+            user_index=result.user_index,
+            item_ids=result.item_ids,
+            item_index=result.item_index,
+            seen=seen,
+            seen_mode=getattr(model, "seen_mode", "model"),
+            app_name=getattr(model, "app_name", ""),
+            event_names=getattr(model, "event_names", None),
+            channel_name=getattr(model, "channel_name", None),
+        )
 
     def predict(self, model: RecommendationModel, query) -> dict:
         num = int(query.get("num", 10))

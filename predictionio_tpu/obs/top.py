@@ -167,6 +167,18 @@ def compute_stats(prev: dict, cur: dict) -> dict:
             max(v - pfw_req.get(k, 0.0), 0.0) for k, v in fw_req.items()
         )
         stats["frontend_qps"] = round(d_fw / dt, 1)
+    # continuous-learning gauges (pio retrain --follow): which model
+    # version is live, how long ago it swapped in, and how many seconds of
+    # ingested events are not yet reflected in it
+    mv = cm.get("pio_model_version")
+    if mv:
+        stats["model_version"] = int(max(mv.values()))
+    swap_ts = cm.get("pio_model_last_swap_timestamp_seconds")
+    if swap_ts:
+        stats["swap_age_s"] = round(max(0.0, time.time() - max(swap_ts.values())), 1)
+    lag = cm.get("pio_foldin_lag_seconds")
+    if lag:
+        stats["foldin_lag_s"] = round(max(lag.values()), 1)
     d_batches = _total(cm.get("pio_serving_batch_size_count")) - _total(
         pm.get("pio_serving_batch_size_count")
     )
@@ -191,7 +203,8 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
         time.strftime("pio top — %H:%M:%S", time.localtime()),
         "",
         f"{'SERVICE':<32}{'QPS':>8}{'P50MS':>9}{'P99MS':>9}"
-        f"{'ERR%':>7}{'QUEUE':>7}{'BATCH':>7}{'WKR':>5}",
+        f"{'ERR%':>7}{'QUEUE':>7}{'BATCH':>7}{'WKR':>5}"
+        f"{'MODEL':>7}{'SWAP':>8}{'LAG':>7}",
     ]
     for s in stats_list:
         if s.get("error"):
@@ -206,6 +219,9 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
             f"{_fmt(s.get('ingest_queue_depth')):>7}"
             f"{_fmt(s.get('batch_occupancy')):>7}"
             f"{_fmt(s.get('frontend_workers')):>5}"
+            f"{_fmt(s.get('model_version')):>7}"
+            f"{_fmt(s.get('swap_age_s'), 's'):>8}"
+            f"{_fmt(s.get('foldin_lag_s'), 's'):>7}"
         )
     slowest: list[tuple[float, str, dict]] = []
     for snap in snapshots:

@@ -1,0 +1,470 @@
+"""The ``pio retrain --follow`` cycle: tail -> refresh -> fold-in -> swap.
+
+One iteration (:meth:`RetrainLoop.run_once`):
+
+1. **tail** -- read the ingest WAL records in ``(cursor, storage
+   checkpoint]`` (``online.follower``). Nothing new -> idle. A GC gap
+   (follower was down past segment retention) -> resync: proceed with the
+   window anchored at the cursor's snapshot bound.
+2. **refresh** -- ``SnapshotStore.ensure(mode="refresh", until=now)``
+   extends the columnar generation by exactly the uncovered scan window
+   (``data/snapshot`` exactness rules apply: late/deleted rows force a
+   rebuild, which fold-in tolerates because it maps entities by STRING id
+   and re-solves from full history).
+3. **fold-in** -- each algorithm's ``fold_in`` hook re-solves the touched
+   user rows against frozen item factors (``online.foldin``); the
+   staleness budget escalates to a FULL ``run_train`` when the delta
+   outgrew the approximation.
+4. **publish + swap** -- the new models serialize into the versioned
+   registry (``online.registry``), then every ``--notify`` query server
+   hot-swaps via ``POST /models/swap`` (the swap-epoch protocol in
+   ``workflow/create_server``: in-flight batches finish on the old
+   handle, zero dropped or mixed-version requests).
+5. **advance** -- ONLY after publish + swap does the durable cursor move.
+   A crash (SIGKILL included) at any earlier point replays the same
+   window next run; fold-in's full-history re-solve makes that replay
+   converge instead of double-applying.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from predictionio_tpu.online.foldin import (
+    FoldinDelta,
+    StalenessBudget,
+    StalenessExceeded,
+)
+from predictionio_tpu.online.follower import TailCursor, WalTail
+from predictionio_tpu.online.registry import ModelRegistry
+
+logger = logging.getLogger("pio.online.loop")
+
+
+@dataclass
+class RetrainConfig:
+    """Knobs of ``pio retrain [--follow]``."""
+
+    interval_s: float = 2.0
+    wal_dir: str | None = None          # default $PIO_FS_BASEDIR/wal
+    registry_dir: str | None = None     # default $PIO_FS_BASEDIR/registry
+    registry_keep: int = 5
+    #: query servers to hot-swap after each publish; empty = batch mode
+    #: (publishing IS the reflection boundary, e.g. feeding `pio deploy
+    #: --model-version` restarts)
+    notify_urls: list[str] = field(default_factory=list)
+    budget: StalenessBudget = field(default_factory=StalenessBudget)
+    #: 0 = run until stopped; tests and `pio retrain` (no --follow) bound it
+    max_cycles: int = 0
+    swap_timeout_s: float = 30.0
+    #: escalation switch: False turns StalenessExceeded into a logged skip
+    #: (for operators who schedule full retrains out of band)
+    allow_full_retrain: bool = True
+
+
+class RetrainLoop:
+    """Owns the follower cursor, the base model state, and the cycle."""
+
+    def __init__(self, variant, config: RetrainConfig | None = None, engine=None):
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.snapshot import (
+            SnapshotSpec,
+            SnapshotStore,
+            snapshot_settings,
+        )
+        from predictionio_tpu.data.storage.sql_common import ts_ms
+        from predictionio_tpu.workflow.context import RuntimeContext
+        from predictionio_tpu.workflow.core_workflow import (
+            engine_params_from_instance,
+            resolve_engine_instance,
+        )
+        from predictionio_tpu.workflow.json_extractor import build_engine
+
+        self.variant = variant
+        self.config = config or RetrainConfig()
+        self.engine = engine or build_engine(variant)
+        self.registry = ModelRegistry.for_variant(
+            variant,
+            registry_dir=self.config.registry_dir,
+            keep=self.config.registry_keep,
+        )
+        self._stop = threading.Event()
+
+        self.instance = resolve_engine_instance(variant)
+        base = self.registry.latest()
+        if base is not None and base.engine_params_obj:
+            from predictionio_tpu.controller.engine import EngineParams
+
+            self.engine_params = EngineParams.from_json_obj(base.engine_params_obj)
+            blob = base.load_blob()
+            base_until_ms = int(base.manifest.get("until_ms", 0))
+            self.current_version = base.version
+            logger.info(
+                "resuming from registry version %d (%s)", base.version,
+                base.source,
+            )
+        else:
+            self.engine_params = engine_params_from_instance(self.instance)
+            record = storage.get_model_data_models().get(self.instance.id)
+            blob = record.models if record else None
+            base_until_ms = ts_ms(self.instance.start_time)
+            self.current_version = None
+        self.ctx = RuntimeContext(self.instance.runtime_conf)
+        self.models = self.engine.prepare_deploy(
+            self.ctx, self.engine_params, self.instance.id, blob
+        )
+        self.algorithms = self.engine._algorithms(self.engine_params)
+
+        data_source = self.engine.data_source_class(
+            self.engine_params.data_source_params
+        )
+        self.handle = data_source.online_handle()
+        if self.handle is None:
+            raise ValueError(
+                f"{type(data_source).__name__} exposes no online handle;"
+                " `pio retrain --follow` needs the datasource to describe"
+                " its interaction scan (app/channel/event names)"
+            )
+        wal_dir = self.config.wal_dir
+        if not wal_dir:
+            from predictionio_tpu.data.storage import base_dir
+
+            wal_dir = os.path.join(base_dir(), "wal")
+        self.tail = WalTail(
+            wal_dir,
+            self.handle.app_id,
+            self.handle.channel_id,
+            self.handle.event_names,
+        )
+        mode, root = snapshot_settings(self.instance.runtime_conf)
+        del mode  # the loop's backbone IS the snapshot; always refresh
+        self.snapshots = SnapshotStore(
+            root,
+            SnapshotSpec(
+                app_id=self.handle.app_id,
+                channel_id=self.handle.channel_id,
+                event_names=(
+                    tuple(self.handle.event_names)
+                    if self.handle.event_names
+                    else None
+                ),
+                rating_key=self.handle.rating_key,
+            ),
+        )
+        self.cursor = TailCursor(os.path.join(self.registry.dir, "follow", "cursor.json"))
+        if self.cursor.until_ms == 0:
+            # fresh cursor: the deployed base model reflects events up to
+            # (at least) its training scan's start; fold-in windows that
+            # overlap it are harmless (full-history re-solve)
+            self.cursor.until_ms = base_until_ms
+        self.last_lag_s = 0.0
+        self.cycles = {"idle": 0, "foldin": 0, "full_retrain": 0,
+                       "noop": 0, "swap_failed": 0}
+
+    # -- one cycle -----------------------------------------------------------
+    def run_once(self) -> str:
+        import datetime as _dt
+
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.utils.metrics import global_registry
+
+        batch = self.tail.poll(self.cursor.seqno)
+        if batch.empty:
+            if batch.last_seqno > self.cursor.seqno:
+                # records were examined but none matched the followed scan
+                # (another app/channel/event type): skip past them so a
+                # busy multi-tenant WAL is not rescanned every poll. The
+                # reflected-model bound (until_ms/rows) is untouched.
+                self.cursor.advance(
+                    batch.last_seqno, self.cursor.until_ms,
+                    self.cursor.snapshot_rows,
+                )
+            self.last_lag_s = 0.0
+            self._push_lag(0.0)
+            self._count("idle")
+            return "idle"
+        self.last_lag_s = batch.lag_seconds()
+        global_registry().set_gauge(
+            "pio_foldin_lag_seconds", self.last_lag_s,
+            help="Age of the oldest ingested event not yet reflected in a"
+            " swapped model",
+        )
+
+        le = storage.get_l_events()
+        until = _dt.datetime.now(_dt.timezone.utc)
+        now_ms = int(until.timestamp() * 1000)
+        if batch.min_event_ms is not None and batch.min_event_ms >= now_ms:
+            # every pending record is future-dated (client clock skew):
+            # the refresh bound (now) cannot cover any of them yet. Keep
+            # the cursor and retry next poll, once their time has passed.
+            self._count("deferred")
+            return "deferred"
+        snap = self.snapshots.ensure(le, "refresh", until_time=until)
+        if snap is None:
+            logger.error(
+                "event backend has no columnar chunk scan; continuous"
+                " learning requires it"
+            )
+            self._count("noop")
+            return "unsupported"
+        if batch.gap:
+            # seqnos were GC'd before this follower saw them: the delta is
+            # UNKNOWN (lost records may touch any user, with any event
+            # time), so a fold-in cannot promise coverage -- rebaseline
+            logger.warning(
+                "WAL GC gap behind cursor %d (oldest retained record is"
+                " newer); escalating to a full retrain", self.cursor.seqno,
+            )
+            return self._full_retrain(
+                batch, snap, "WAL GC gap: records collected unseen"
+            )
+        window_start_ms = self.cursor.until_ms
+        if batch.min_event_ms is not None:
+            # client-supplied event times may predate the cursor bound
+            window_start_ms = min(window_start_ms, batch.min_event_ms)
+        delta = FoldinDelta(
+            snapshot=snap,
+            window_start_ms=window_start_ms,
+            touched_user_ids=set(batch.touched_users) or None,
+            budget=self.config.budget,
+            extras=dict(getattr(self.handle, "extras", None) or {}),
+        )
+        try:
+            if not all(
+                getattr(a, "supports_fold_in", False) for a in self.algorithms
+            ):
+                raise StalenessExceeded(
+                    "algorithm(s) without a fold_in hook: "
+                    + ", ".join(
+                        type(a).__name__
+                        for a in self.algorithms
+                        if not getattr(a, "supports_fold_in", False)
+                    )
+                )
+            new_models = []
+            any_change = False
+            for algorithm, model in zip(self.algorithms, self.models):
+                folded = algorithm.fold_in(model, delta)
+                if folded is None:
+                    new_models.append(model)
+                else:
+                    any_change = True
+                    new_models.append(folded)
+        except StalenessExceeded as exc:
+            return self._full_retrain(batch, snap, str(exc))
+        if not any_change:
+            # e.g. the window's records carried no scorable interaction
+            self._maybe_advance(batch, snap)
+            self._count("noop")
+            return "noop"
+
+        self._test_hold()
+        blob = self.engine.serialize_models(
+            self.ctx, self.engine_params, self.instance.id, new_models
+        )
+        version = self.registry.publish(
+            blob,
+            meta=self._meta("foldin", batch, snap),
+        )
+        if not self._notify_swap(version.version):
+            self._count("swap_failed")
+            return "swap_failed"  # cursor stays; next cycle re-folds
+        self.models = new_models
+        self.current_version = version.version
+        self._maybe_advance(batch, snap)
+        self._count("foldin")
+        logger.info(
+            "fold-in v%d: %d record(s), %d touched user(s), lag %.2fs",
+            version.version, batch.records, len(batch.touched_users),
+            self.last_lag_s,
+        )
+        return "foldin"
+
+    def _full_retrain(self, batch, snap, reason: str) -> str:
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.workflow.core_workflow import (
+            engine_params_from_instance,
+            run_train,
+        )
+
+        if not self.config.allow_full_retrain:
+            logger.warning(
+                "staleness budget exceeded (%s) but full retrain is"
+                " disabled; model keeps serving stale", reason,
+            )
+            self._count("noop")
+            return "noop"
+        logger.info("escalating to full retrain: %s", reason)
+        instance = run_train(self.variant)
+        record = storage.get_model_data_models().get(instance.id)
+        if record is None:
+            # every template ships SOME blob (even retrain-on-deploy marks);
+            # a missing row means the train did not persist -- do not
+            # publish an unloadable version, and leave the cursor so the
+            # next cycle retries
+            logger.error(
+                "trained instance %s has no model blob; not publishing",
+                instance.id,
+            )
+            self._count("error")
+            return "error"
+        self.instance = instance
+        # re-derive params from the NEW instance: the operator may have
+        # edited engine.json since the loop's base was published, and the
+        # manifest/rehydration must describe the model actually trained
+        self.engine_params = engine_params_from_instance(instance)
+        self.algorithms = self.engine._algorithms(self.engine_params)
+        self.models = self.engine.prepare_deploy(
+            self.ctx, self.engine_params, instance.id, record.models
+        )
+        version = self.registry.publish(
+            record.models,
+            meta=self._meta("train", batch, snap, instance_id=instance.id),
+        )
+        if not self._notify_swap(version.version):
+            self._count("swap_failed")
+            return "swap_failed"
+        self.current_version = version.version
+        self._advance(batch, snap)
+        self._count("full_retrain")
+        return "full_retrain"
+
+    # -- plumbing ------------------------------------------------------------
+    def _meta(self, source: str, batch, snap, instance_id: str | None = None) -> dict:
+        return {
+            "source": source,
+            "instance_id": instance_id or self.instance.id,
+            "engine_params": self.engine_params.to_json_obj(),
+            "wal_seqno": batch.last_seqno,
+            "until_ms": int(snap.manifest["until_ms"]),
+            "records": batch.records,
+            "touched_users": len(batch.touched_users),
+        }
+
+    def _advance(self, batch, snap) -> None:
+        self.cursor.advance(
+            batch.last_seqno, int(snap.manifest["until_ms"]), len(snap)
+        )
+
+    #: clock-skew horizon: a batch containing a record dated further ahead
+    #: than this still advances (with a warning) instead of replaying every
+    #: poll until the far-future time passes
+    MAX_DEFER_SKEW_MS = 300_000
+
+    def _maybe_advance(self, batch, snap) -> None:
+        """Advance the cursor -- unless the batch contains a record whose
+        event time the refresh bound could not cover yet (future-dated via
+        client clock skew, within ``MAX_DEFER_SKEW_MS``). Deferring keeps
+        the record in the tail window so the next poll replays it once its
+        time has passed; replay is free because fold-in re-solves from
+        full history."""
+        until_ms = int(snap.manifest["until_ms"])
+        if batch.max_event_ms is not None and batch.max_event_ms >= until_ms:
+            skew = batch.max_event_ms - until_ms
+            if skew < self.MAX_DEFER_SKEW_MS:
+                logger.info(
+                    "deferring cursor: a record is dated %.1fs ahead of the"
+                    " refresh bound (client clock skew); will replay",
+                    skew / 1000.0,
+                )
+                return
+            logger.warning(
+                "record dated %.1fs in the future (beyond the %.0fs defer"
+                " horizon): advancing past it; it folds at the next cycle"
+                " after its event time passes", skew / 1000.0,
+                self.MAX_DEFER_SKEW_MS / 1000.0,
+            )
+        self._advance(batch, snap)
+
+    def _count(self, result: str) -> None:
+        from predictionio_tpu.utils.metrics import global_registry
+
+        self.cycles[result] = self.cycles.get(result, 0) + 1
+        global_registry().inc(
+            "pio_online_cycles_total", {"result": result},
+            help="Continuous-learning cycles by outcome",
+        )
+        if self.current_version is not None:
+            global_registry().set_gauge(
+                "pio_model_version", float(self.current_version),
+                help="Latest registry model version this loop swapped in",
+            )
+
+    def _test_hold(self) -> None:
+        """Crash-injection window for the SIGKILL recovery tests: sleep
+        between fold-in and publish when the env asks for it, announcing
+        the window via a marker file so the killer does not race the
+        fold. Inert in production -- the env vars are unset."""
+        hold = float(os.environ.get("PIO_ONLINE_TEST_HOLD_S", "0") or 0)
+        if hold > 0:
+            marker = os.environ.get("PIO_ONLINE_TEST_HOLD_FILE")
+            if marker:
+                with open(marker, "w") as f:
+                    f.write("holding")
+            time.sleep(hold)
+
+    def _post(self, url: str, path: str, obj: dict) -> dict:
+        req = urllib.request.Request(
+            f"{url}{path}",
+            data=json.dumps(obj).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(
+            req, timeout=self.config.swap_timeout_s
+        ) as resp:
+            return json.loads(resp.read().decode("utf-8") or "{}")
+
+    def _notify_swap(self, version: int) -> bool:
+        """Hot-swap ``version`` into every notify target. True once at
+        least one server swapped (or none are configured: publish is the
+        boundary in batch mode) -- a single dead replica must not wedge
+        the cursor forever; it catches up from the registry on restart."""
+        if not self.config.notify_urls:
+            return True
+        ok = 0
+        for url in self.config.notify_urls:
+            try:
+                self._post(
+                    url, "/models/swap",
+                    {"version": version, "foldinLagSeconds": self.last_lag_s},
+                )
+                ok += 1
+            except Exception as exc:
+                logger.warning("swap notify failed for %s: %s", url, exc)
+        return ok > 0
+
+    def _push_lag(self, lag_s: float) -> None:
+        """Best-effort lag heartbeat so `pio top` shows fold-in lag from
+        the query server's /metrics even between swaps."""
+        for url in self.config.notify_urls:
+            try:
+                self._post(url, "/models/lag", {"foldinLagSeconds": lag_s})
+            except Exception:
+                pass
+
+    # -- the follow loop -----------------------------------------------------
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run_follow(self) -> dict:
+        """Cycle until stopped (or ``max_cycles``); one failure logs and
+        backs off instead of killing the loop. Returns the cycle counts."""
+        n = 0
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:
+                logger.exception("retrain cycle failed; backing off")
+                self._count("error")
+            n += 1
+            if self.config.max_cycles and n >= self.config.max_cycles:
+                break
+            self._stop.wait(self.config.interval_s)
+        return dict(self.cycles)

@@ -72,6 +72,13 @@ def register(sub: argparse._SubParsersAction) -> None:
     deploy.add_argument("--ip", default="0.0.0.0")
     deploy.add_argument("--port", type=int, default=8000)
     deploy.add_argument("--engine-instance-id", default=None)
+    deploy.add_argument(
+        "--model-version", type=int, default=None, metavar="N",
+        help="deploy an exact model-registry version (the continuous-"
+        "learning registry `pio retrain` publishes into) instead of the"
+        " latest trained instance -- the rollback lever; fails loudly on a"
+        " missing or corrupt version",
+    )
     deploy.add_argument("--feedback", action="store_true")
     deploy.add_argument("--event-server-ip", default="localhost")
     deploy.add_argument("--event-server-port", type=int, default=7070)
@@ -132,6 +139,64 @@ def register(sub: argparse._SubParsersAction) -> None:
     )
     add_logging_arguments(deploy)
     deploy.set_defaults(func=cmd_deploy)
+
+    retrain = sub.add_parser(
+        "retrain",
+        help="continuous learning: tail the ingest WAL, fold new events"
+        " into the model, hot-swap running query servers (--follow loops;"
+        " without it one catch-up cycle runs)",
+    )
+    _add_variant_args(retrain)
+    retrain.add_argument(
+        "--follow", action="store_true",
+        help="keep following the WAL until interrupted (the online loop);"
+        " default is one catch-up cycle",
+    )
+    retrain.add_argument(
+        "--interval", type=float, default=2.0, metavar="SEC",
+        help="seconds between WAL polls in --follow mode",
+    )
+    retrain.add_argument(
+        "--notify", action="append", default=[], metavar="URL",
+        help="query server base URL to hot-swap after each publish"
+        " (repeatable; default http://localhost:8000 -- pass --notify ''"
+        " for batch mode, where publishing to the registry is the"
+        " reflection boundary)",
+    )
+    retrain.add_argument(
+        "--wal-dir", default=None,
+        help="ingest WAL directory to tail (default $PIO_FS_BASEDIR/wal;"
+        " must match the event server's --wal-dir)",
+    )
+    retrain.add_argument(
+        "--registry-dir", default=None,
+        help="model registry root (default $PIO_FS_BASEDIR/registry)",
+    )
+    retrain.add_argument(
+        "--registry-keep", type=int, default=5, metavar="N",
+        help="retained model versions (each is a rollback target)",
+    )
+    retrain.add_argument(
+        "--max-touched-frac", type=float, default=0.2, metavar="F",
+        help="staleness budget: touched-user fraction beyond which a full"
+        " retrain replaces fold-in",
+    )
+    retrain.add_argument(
+        "--max-item-growth-frac", type=float, default=0.05, metavar="F",
+        help="staleness budget: new-item fraction beyond which a full"
+        " retrain replaces fold-in (fold-in gives new items zero factors)",
+    )
+    retrain.add_argument(
+        "--no-full-retrain", action="store_true",
+        help="never escalate to a full retrain (log and keep serving"
+        " stale instead; schedule retrains out of band)",
+    )
+    retrain.add_argument(
+        "--max-cycles", type=int, default=0, metavar="N",
+        help="stop after N cycles (0 = until interrupted; test/bench knob)",
+    )
+    add_logging_arguments(retrain)
+    retrain.set_defaults(func=cmd_retrain)
 
     undeploy = sub.add_parser("undeploy", help="stop a deployed engine server")
     undeploy.add_argument("--ip", default="localhost")
@@ -257,23 +322,70 @@ def cmd_deploy(args: argparse.Namespace) -> int:
             ring_slots=args.frontend_ring_slots,
             max_inflight=args.frontend_max_inflight,
         )
-    run_query_server(
-        variant,
-        host=args.ip,
-        port=args.port,
-        instance_id=args.engine_instance_id,
-        feedback=feedback,
-        ssl_cert=args.ssl_cert,
-        ssl_key=args.ssl_key,
-        batching=BatchConfig(
-            max_batch_size=args.max_batch_size,
-            window_ms=args.batch_window_ms,
-            buckets=buckets,
+    from predictionio_tpu.online.registry import RegistryError
+
+    try:
+        run_query_server(
+            variant,
+            host=args.ip,
+            port=args.port,
+            instance_id=args.engine_instance_id,
+            model_version=args.model_version,
+            feedback=feedback,
+            ssl_cert=args.ssl_cert,
+            ssl_key=args.ssl_key,
+            batching=BatchConfig(
+                max_batch_size=args.max_batch_size,
+                window_ms=args.batch_window_ms,
+                buckets=buckets,
+            ),
+            tracing=False if args.no_tracing else None,
+            trace_sample=args.trace_sample,
+            slow_query_ms=args.slow_query_ms,
+            frontend=frontend,
+        )
+    except RegistryError as exc:
+        # --model-version names an exact artifact; a missing or corrupt one
+        # must be an actionable error, never a silent fallback deploy
+        raise SystemExit(f"Error: {exc}")
+    return 0
+
+
+def cmd_retrain(args: argparse.Namespace) -> int:
+    from predictionio_tpu.obs.logs import configure_logging
+    from predictionio_tpu.online.foldin import StalenessBudget
+    from predictionio_tpu.online.loop import RetrainConfig, RetrainLoop
+
+    configure_logging(args.log_format)
+    variant = _load_variant(args)
+    notify = [u for u in (args.notify or ["http://localhost:8000"]) if u]
+    config = RetrainConfig(
+        interval_s=args.interval,
+        wal_dir=args.wal_dir,
+        registry_dir=args.registry_dir,
+        registry_keep=args.registry_keep,
+        notify_urls=notify,
+        budget=StalenessBudget(
+            max_touched_frac=args.max_touched_frac,
+            max_item_growth_frac=args.max_item_growth_frac,
         ),
-        tracing=False if args.no_tracing else None,
-        trace_sample=args.trace_sample,
-        slow_query_ms=args.slow_query_ms,
-        frontend=frontend,
+        max_cycles=args.max_cycles if args.follow else 1,
+        allow_full_retrain=not args.no_full_retrain,
+    )
+    try:
+        loop = RetrainLoop(variant, config)
+    except (LookupError, ValueError) as exc:
+        raise SystemExit(f"Error: {exc}")
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: loop.stop())
+    try:
+        counts = loop.run_follow()
+    except KeyboardInterrupt:
+        counts = dict(loop.cycles)
+    print(
+        "Retrain loop finished: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(counts.items()) if v)
     )
     return 0
 

@@ -1,0 +1,317 @@
+"""Continuous-learning freshness A/B: ingest -> visible-in-query latency.
+
+Usage::
+
+    python -m predictionio_tpu.tools.retrain_bench [--probes 5]
+
+Measures ``online_freshness_seconds`` -- the wall time between an event's
+durable ingest (WAL append + storage flush + checkpoint, the exact cycle
+the event server's group-commit pipeline runs) and the FIRST
+``/queries.json`` response that reflects it -- under concurrent serving
+load, for two arms sharing one deployment:
+
+- **foldin**  -- ``pio retrain --follow`` semantics: the loop tails the
+  WAL, refreshes the snapshot, fold-in-solves the touched user rows, and
+  hot-swaps the query server (``online.loop``);
+- **full**    -- the same loop forced to escalate (``max_touched_frac=0``):
+  every delta triggers a complete ``run_train`` + swap, the pre-PR-9
+  freshness floor.
+
+Each probe ingests one event for a PREVIOUSLY UNKNOWN user and polls the
+query server until that user's recommendations turn non-empty -- a
+response only a model reflecting the event can produce. Load clients
+hammer known users throughout; the report asserts their error count is
+zero (hot swaps must drop nothing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from predictionio_tpu.data import storage as storage_registry
+from predictionio_tpu.tools.ingest_bench import _Env
+
+APP = "RetrainBenchApp"
+APP_ID = 1
+
+
+def _engine_json(workdir: str, rank: int, iterations: int) -> str:
+    path = os.path.join(workdir, "engine.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "id": "retrain-bench",
+                "engineFactory": (
+                    "predictionio_tpu.models.recommendation.engine"
+                    ".engine_factory"
+                ),
+                "datasource": {"params": {"appName": APP}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {
+                            "rank": rank,
+                            "numIterations": iterations,
+                            "seed": 7,
+                            "checkpointInterval": 0,
+                        },
+                    }
+                ],
+            },
+            f,
+        )
+    return path
+
+
+def _populate(le, events: int, users: int, items: int) -> None:
+    import datetime as _dt
+
+    from predictionio_tpu.data import DataMap, Event
+
+    rng = np.random.default_rng(17)
+    base = _dt.datetime.now(_dt.timezone.utc) - _dt.timedelta(hours=1)
+    le.batch_insert(
+        [
+            Event(
+                event="rate",
+                entity_type="user",
+                entity_id=f"u{rng.integers(0, users)}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.integers(0, items)}",
+                properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                event_time=base + _dt.timedelta(milliseconds=13 * k),
+            )
+            for k in range(events)
+        ],
+        app_id=APP_ID,
+    )
+
+
+def _ingest_one(wal, le, user: str, item: str) -> float:
+    """One durable ingest through the WAL pipeline's exact cycle; returns
+    the ack time (the freshness clock's zero)."""
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.data.ingest import wal_payload
+
+    event = Event(
+        event="rate",
+        entity_type="user",
+        entity_id=user,
+        target_entity_type="item",
+        target_entity_id=item,
+        properties=DataMap({"rating": 5.0}),
+    ).with_id()
+    seqno = wal.append(wal_payload(event, APP_ID, None))
+    wal.sync()
+    t_ack = time.perf_counter()
+    le.insert_batch([(event, APP_ID, None)], on_duplicate="ignore")
+    wal.checkpoint(seqno)
+    return t_ack
+
+
+def _post_query(url: str, body: dict, timeout: float = 15.0):
+    req = urllib.request.Request(
+        f"{url}/queries.json",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _measure_arm(
+    label: str,
+    server_url: str,
+    variant,
+    wal,
+    budget,
+    probes: int,
+    load_clients: int,
+    freshness_timeout_s: float,
+    interval_s: float,
+) -> dict:
+    from predictionio_tpu.online.loop import RetrainConfig, RetrainLoop
+
+    loop = RetrainLoop(
+        variant,
+        RetrainConfig(
+            interval_s=interval_s,
+            notify_urls=[server_url],
+            budget=budget,
+        ),
+    )
+    loop_thread = threading.Thread(target=loop.run_follow, daemon=True)
+    loop_thread.start()
+
+    stop = threading.Event()
+    load_errors = [0]
+    load_count = [0]
+
+    def load_worker(k: int) -> None:
+        rng = np.random.default_rng(100 + k)
+        while not stop.is_set():
+            try:
+                status, _ = _post_query(
+                    server_url, {"user": f"u{rng.integers(0, 20)}", "num": 3}
+                )
+                if status != 200:
+                    load_errors[0] += 1
+            except Exception:
+                load_errors[0] += 1
+            load_count[0] += 1
+
+    workers = [
+        threading.Thread(target=load_worker, args=(k,), daemon=True)
+        for k in range(load_clients)
+    ]
+    for w in workers:
+        w.start()
+
+    latencies = []
+    timeouts = 0
+    try:
+        for k in range(probes):
+            user = f"fresh-{label}-{k}"
+            t_ack = _ingest_one(wal, le=storage_registry.get_l_events(),
+                                user=user, item=f"i{k % 10}")
+            deadline = t_ack + freshness_timeout_s
+            seen = None
+            while time.perf_counter() < deadline:
+                try:
+                    status, body = _post_query(server_url, {"user": user, "num": 3})
+                except Exception:
+                    time.sleep(0.05)
+                    continue
+                if status == 200 and body.get("itemScores"):
+                    seen = time.perf_counter()
+                    break
+                time.sleep(0.05)
+            if seen is None:
+                timeouts += 1
+            else:
+                latencies.append(seen - t_ack)
+    finally:
+        stop.set()
+        loop.stop()
+        loop_thread.join(timeout=30)
+        for w in workers:
+            w.join(timeout=10)
+    return {
+        "probes": probes,
+        "timeouts": timeouts,
+        "freshness_s_median": (
+            round(statistics.median(latencies), 3) if latencies else None
+        ),
+        "freshness_s_max": round(max(latencies), 3) if latencies else None,
+        "load_requests": load_count[0],
+        "load_errors": load_errors[0],
+        "cycles": dict(loop.cycles),
+    }
+
+
+def run_ab(
+    events: int = 2_000,
+    users: int = 60,
+    items: int = 30,
+    rank: int = 8,
+    iterations: int = 3,
+    probes: int = 4,
+    load_clients: int = 2,
+    freshness_timeout_s: float = 30.0,
+    interval_s: float = 0.2,
+    workdir: str | None = None,
+    full_retrain_arm: bool = True,
+) -> dict:
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.wal import WriteAheadLog
+    from predictionio_tpu.online.foldin import StalenessBudget
+    from predictionio_tpu.workflow.core_workflow import run_train
+    from predictionio_tpu.workflow.create_server import create_query_server
+    from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+    report: dict = {
+        "events": events, "users": users, "items": items, "rank": rank,
+    }
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="pio_retrain_bench_")
+    with _Env(workdir):
+        storage_registry.get_meta_data_apps().insert(App(name=APP))
+        le = storage_registry.get_l_events()
+        le.init_channel(APP_ID)
+        _populate(le, events, users, items)
+        variant = load_engine_variant(_engine_json(workdir, rank, iterations))
+        t0 = time.perf_counter()
+        run_train(variant)
+        report["train_seconds"] = round(time.perf_counter() - t0, 3)
+
+        wal = WriteAheadLog(os.path.join(workdir, "wal"))
+        thread, service = create_query_server(variant, host="127.0.0.1", port=0)
+        thread.start()
+        url = f"http://127.0.0.1:{thread.port}"
+        try:
+            report["foldin"] = _measure_arm(
+                "fold", url, variant, wal, StalenessBudget(
+                    max_touched_frac=1.0, max_item_growth_frac=1.0,
+                    max_user_growth_frac=10.0,
+                ),
+                probes, load_clients, freshness_timeout_s, interval_s,
+            )
+            if full_retrain_arm:
+                report["full_retrain"] = _measure_arm(
+                    "full", url, variant, wal,
+                    StalenessBudget(max_touched_frac=0.0),
+                    probes, load_clients, freshness_timeout_s, interval_s,
+                )
+                a = report["foldin"].get("freshness_s_median")
+                b = report["full_retrain"].get("freshness_s_median")
+                if a and b:
+                    report["foldin_speedup"] = round(b / a, 2)
+        finally:
+            thread.stop()
+            service.close()
+            wal.close()
+    if own_tmp:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--events", type=int, default=2_000)
+    parser.add_argument("--users", type=int, default=60)
+    parser.add_argument("--items", type=int, default=30)
+    parser.add_argument("--rank", type=int, default=8)
+    parser.add_argument("--iterations", type=int, default=3)
+    parser.add_argument("--probes", type=int, default=4)
+    parser.add_argument("--load-clients", type=int, default=2)
+    parser.add_argument("--no-full-retrain-arm", action="store_true")
+    args = parser.parse_args(argv)
+    report = run_ab(
+        events=args.events,
+        users=args.users,
+        items=args.items,
+        rank=args.rank,
+        iterations=args.iterations,
+        probes=args.probes,
+        load_clients=args.load_clients,
+        full_retrain_arm=not args.no_full_retrain_arm,
+    )
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

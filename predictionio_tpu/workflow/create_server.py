@@ -34,6 +34,7 @@ import datetime as _dt
 import json
 import logging
 import threading
+import time as _time
 import uuid
 from concurrent.futures import TimeoutError as _FutureTimeout
 from dataclasses import dataclass
@@ -101,10 +102,14 @@ class QueryService:
         trace_sample: float | None = None,
         slow_query_ms: float | None = None,
         extra_metrics_snapshots=None,
+        model_version: int | None = None,
+        registry=None,
     ):
         self.variant = variant
         self.engine = engine or build_engine(variant)
         self.requested_instance_id = instance_id
+        self.requested_model_version = model_version
+        self._registry = registry  # lazily resolved from the variant
         self.feedback = feedback
         self.plugins = list(plugins or [])
         self.batching = BatchConfig() if batching is None else batching
@@ -112,8 +117,19 @@ class QueryService:
         #: page (``pio top``/operators see the process model at a glance)
         self.frontend_info: dict | None = None
         self._lock = threading.RLock()
+        #: serializes whole swap operations (rehydrate + bind): without it
+        #: two concurrent swaps bind in COMPLETION order, so a slow
+        #: rollback rehydrate could silently overwrite a newer version
+        #: that already reported success. Queries never take this lock.
+        self._swap_lock = threading.Lock()
         self._served = 0
         self._started = _dt.datetime.now(_dt.timezone.utc)
+        #: swap-epoch state: which registry version is live (None = plain
+        #: instance deploy), when it was swapped in, and the last fold-in
+        #: lag the retrain loop pushed (``online.loop``)
+        self.model_version: int | None = None
+        self.last_swap_ts: float | None = None
+        self.foldin_lag_s: float | None = None
         self._load_models()
 
         # _served stays the single source of truth (handle_info reads it);
@@ -121,6 +137,9 @@ class QueryService:
         def mirror(registry):
             with self._lock:
                 served = self._served
+                version = self.model_version
+                swap_ts = self.last_swap_ts
+                lag = self.foldin_lag_s
             registry.set_counter(
                 "pio_queries_served_total", served,
                 help="Queries answered successfully",
@@ -129,6 +148,22 @@ class QueryService:
                 registry.set_gauge(
                     "pio_serving_queue_depth", self._batcher.depth(),
                     help="Queries waiting in the micro-batcher queue",
+                )
+            if version is not None:
+                registry.set_gauge(
+                    "pio_model_version", float(version),
+                    help="Registry model version currently serving",
+                )
+            if swap_ts is not None:
+                registry.set_gauge(
+                    "pio_model_last_swap_timestamp_seconds", swap_ts,
+                    help="Unix time of the last model hot swap",
+                )
+            if lag is not None:
+                registry.set_gauge(
+                    "pio_foldin_lag_seconds", lag,
+                    help="Seconds of ingested events not yet reflected in"
+                    " the serving model (pushed by pio retrain --follow)",
                 )
 
         self.router, self.metrics = instrumented_router(
@@ -145,6 +180,9 @@ class QueryService:
         self.router.add("POST", "/queries.json", self.handle_query)
         self.router.add("GET", "/reload", self.handle_reload)
         self.router.add("POST", "/stop", self.handle_stop)
+        self.router.add("POST", "/models/swap", self.handle_model_swap)
+        self.router.add("POST", "/models/lag", self.handle_model_lag)
+        self.router.add("GET", "/models.json", self.handle_models)
         self._stop_event = threading.Event()
         # the batcher captures engine state per flush (under self._lock),
         # so /reload hot-swaps apply to the very next batch; it fans
@@ -159,10 +197,26 @@ class QueryService:
         )
 
     # -- model lifecycle ----------------------------------------------------
+    def registry(self):
+        """The variant's model registry (``online.registry``), resolved
+        lazily so plain deploys never touch the registry tree."""
+        if self._registry is None:
+            from predictionio_tpu.online.registry import ModelRegistry
+
+            self._registry = ModelRegistry.for_variant(self.variant)
+        return self._registry
+
     def _load_models(self) -> None:
         from predictionio_tpu.data import storage
         from predictionio_tpu.utils.platform import ensure_backend
 
+        if self.requested_model_version is not None:
+            # pinned registry deploy / rollback: the version's manifest is
+            # self-contained (params + blob); a missing or corrupt version
+            # raises RegistryError verbatim -- deploy must fail loudly,
+            # never silently serve a different model than the one named
+            self._swap_to_version(self.requested_model_version)
+            return
         instance = resolve_engine_instance(self.variant, self.requested_instance_id)
         engine_params = engine_params_from_instance(instance)
         # resolve the instance FIRST so an explicit pio.platform in its
@@ -186,9 +240,92 @@ class QueryService:
             self.models = models
             self.algorithms = algorithms
             self.serving_instance = serving
+            self.model_version = None
         logger.info(
             "deployed engine instance %s (%d algorithm(s))", instance.id, len(models)
         )
+
+    def _swap_to_version(self, version: int | None) -> int:
+        """THE hot-swap epoch protocol: rehydrate a registry version
+        OUTSIDE the lock (deserialization and warm-up are slow), then bind
+        the whole epoch -- instance, params, models, algorithms, serving,
+        version -- in ONE locked assignment. Query paths snapshot the
+        epoch under the same lock (``_predict_batch``/``_predict_one``),
+        so every in-flight batch finishes on the handle it captured, every
+        later submission binds the new one, and no response is ever
+        computed from a mixed-version epoch. Returns the swapped version;
+        raises ``online.registry.RegistryError`` on a missing/corrupt one
+        (the old epoch keeps serving untouched). Swaps are serialized
+        against each other (``_swap_lock``) so they take effect in
+        REQUEST order, not rehydrate-completion order."""
+        with self._swap_lock:
+            return self._swap_to_version_locked(version)
+
+    def _swap_to_version_locked(self, version: int | None) -> int:
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.utils.platform import ensure_backend
+
+        registry = self.registry()
+        entry = registry.get(version) if version is not None else registry.latest()
+        if entry is None:
+            from predictionio_tpu.online.registry import RegistryError
+
+            raise RegistryError(
+                f"model registry is empty under {registry.dir}; run"
+                " `pio train` or `pio retrain` first"
+            )
+        blob = entry.load_blob()  # CRC-verified
+        params_obj = entry.engine_params_obj
+        engine_params = (
+            EngineParams.from_json_obj(params_obj)
+            if params_obj
+            else engine_params_from_instance(
+                resolve_engine_instance(self.variant, entry.instance_id or None)
+            )
+        )
+        ensure_backend(
+            (self.variant.runtime_conf or {}).get("pio.platform"), fallback=True
+        )
+        ctx = RuntimeContext(self.variant.runtime_conf)
+        models = self.engine.prepare_deploy(
+            ctx, engine_params, entry.instance_id or "", blob
+        )
+        algorithms = self.engine._algorithms(engine_params)
+        serving = self.engine.serving(engine_params)
+        instance = None
+        if entry.instance_id:
+            try:
+                instance = resolve_engine_instance(self.variant, entry.instance_id)
+            except LookupError:
+                instance = None
+        if instance is None and getattr(self, "instance", None) is None:
+            # registry-only deploy whose meta row is gone: a stub keeps the
+            # info page honest instead of crashing it
+            from predictionio_tpu.data.storage.base import EngineInstance
+
+            instance = EngineInstance(
+                id=entry.instance_id or f"registry-v{entry.version}",
+                status="COMPLETED",
+                start_time=self._started,
+                engine_id=self.variant.variant_id,
+                engine_version=self.variant.engine_version,
+                engine_variant=self.variant.path,
+                engine_factory=self.variant.engine_factory,
+            )
+        with self._lock:
+            if instance is not None:
+                self.instance = instance
+            self.engine_params = engine_params
+            self.models = models
+            self.algorithms = algorithms
+            self.serving_instance = serving
+            self.model_version = entry.version
+            self.last_swap_ts = _time.time()
+        logger.info(
+            "hot-swapped model version %d (%s, instance %s)",
+            entry.version, entry.source, entry.instance_id or "?",
+        )
+        return entry.version
 
     # -- handlers -----------------------------------------------------------
     def handle_info(self, request: Request) -> Response:
@@ -201,6 +338,7 @@ class QueryService:
                     "startTime": self.instance.start_time.isoformat(),
                 },
                 "algorithms": [type(a).__name__ for a in self.algorithms],
+                "modelVersion": self.model_version,
                 "startTime": self._started.isoformat(),
                 "serverStats": {"queryCount": self._served},
                 "batching": {
@@ -215,30 +353,37 @@ class QueryService:
             return Response(200, body)
 
     def _predict_one(self, query_obj) -> Any:
-        """The unbatched predict -> serve chain for one raw query dict."""
+        """The unbatched predict -> serve chain for one raw query dict;
+        returns ``(result, model_version)`` -- the version is the epoch's,
+        captured in the SAME lock acquisition as the model handles, so a
+        concurrent hot swap can never mislabel a response."""
         with self._lock:
             algorithms = self.algorithms
             models = self.models
             serving = self.serving_instance
+            version = self.model_version
         predictions = []
         typed_query = algorithms[0].query_from_json(query_obj)
         for algorithm, model in zip(algorithms, models):
             query = algorithm.query_from_json(query_obj)
             predictions.append(algorithm.predict(model, query))
         # serving receives the typed query, matching Engine.eval's contract
-        return serving.serve(typed_query, predictions)
+        return serving.serve(typed_query, predictions), version
 
     def _predict_batch(self, query_objs: list) -> list:
-        """MicroBatcher execute callback: raw query dicts in, one result OR
-        ``Exception`` per slot out (aligned). Per-request isolation: the
-        batched hooks run optimistically for the whole batch; if one
-        raises, the batch degrades to per-query scoring so only the
-        failing queries carry their error (the ``workflow/batch_predict``
-        chunk-fallback pattern, on the serving path)."""
+        """MicroBatcher execute callback: raw query dicts in, one
+        ``(result, model_version)`` OR ``Exception`` per slot out
+        (aligned). Per-request isolation: the batched hooks run
+        optimistically for the whole batch; if one raises, the batch
+        degrades to per-query scoring so only the failing queries carry
+        their error (the ``workflow/batch_predict`` chunk-fallback
+        pattern, on the serving path). The whole batch binds ONE epoch --
+        the swap protocol's no-mixed-version guarantee."""
         with self._lock:
             algorithms = self.algorithms
             models = self.models
             serving = self.serving_instance
+            version = self.model_version
         n = len(query_objs)
         errors: dict[int, Exception] = {}
         typed: dict[int, Any] = {}
@@ -300,7 +445,10 @@ class QueryService:
                         )
                     except Exception as exc:
                         errors[i] = exc
-        return [errors[i] if i in errors else served[i] for i in range(n)]
+        return [
+            errors[i] if i in errors else (served[i], version)
+            for i in range(n)
+        ]
 
     def handle_query(self, request: Request) -> Response:
         tracer = self.router.tracer
@@ -315,7 +463,7 @@ class QueryService:
                 # top covers execution (first-bucket jit compiles included)
                 wait_s = self.batching.window_ms / 1000.0 + 30.0
                 try:
-                    result = self._batcher.submit(query_obj).result(wait_s)
+                    result, version = self._batcher.submit(query_obj).result(wait_s)
                 except BatcherStopped:
                     return Response(503, {"message": "server is stopping"})
                 except _FutureTimeout:
@@ -324,7 +472,7 @@ class QueryService:
                     )
             else:
                 with tracer.span("query.predict"):
-                    result = self._predict_one(query_obj)
+                    result, version = self._predict_one(query_obj)
             for plugin in self.plugins:
                 plugin.output_blocker(query_obj, result)
         except ServerRejection as exc:
@@ -351,12 +499,89 @@ class QueryService:
             ).start()
         with self._lock:
             self._served += 1
-        return Response(200, result_json)
+        response = Response(200, result_json)
+        if version is not None:
+            # attribution header: which registry version computed THIS
+            # response (captured in the predict path's epoch snapshot, so
+            # it is exact across concurrent hot swaps). Bodies stay
+            # byte-identical to a plain deploy; the header only exists
+            # once the registry/swap subsystem is in play.
+            response.headers["x-pio-model-version"] = str(version)
+        return response
+
+    def handle_model_swap(self, request: Request) -> Response:
+        """``POST /models/swap {"version": N?}``: hot-swap a registry
+        version (default: latest) into the live epoch. The retrain loop's
+        notify target; also the runtime rollback lever -- POST an older
+        retained version to roll back with zero downtime."""
+        from predictionio_tpu.online.registry import RegistryError
+
+        try:
+            body = request.json() or {}
+        except json.JSONDecodeError:
+            return Response(400, {"message": "malformed JSON body"})
+        version = body.get("version")
+        if version is not None:
+            try:
+                version = int(version)
+            except (TypeError, ValueError):
+                return Response(400, {"message": f"bad version {version!r}"})
+        try:
+            swapped = self._swap_to_version(version)
+        except RegistryError as exc:
+            return Response(404, {"message": str(exc)})
+        except Exception as exc:
+            logger.exception("model swap failed")
+            return Response(500, {"message": f"swap failed: {exc}"})
+        lag = body.get("foldinLagSeconds")
+        if isinstance(lag, (int, float)):
+            with self._lock:
+                self.foldin_lag_s = float(lag)
+        return Response(200, {"status": "swapped", "modelVersion": swapped})
+
+    def handle_model_lag(self, request: Request) -> Response:
+        """Fold-in lag heartbeat from the retrain loop (keeps `pio top`'s
+        LAG column live between swaps)."""
+        try:
+            body = request.json() or {}
+        except json.JSONDecodeError:
+            return Response(400, {"message": "malformed JSON body"})
+        lag = body.get("foldinLagSeconds")
+        if not isinstance(lag, (int, float)):
+            return Response(400, {"message": "foldinLagSeconds required"})
+        with self._lock:
+            self.foldin_lag_s = float(lag)
+        return Response(200, {"status": "ok"})
+
+    def handle_models(self, request: Request) -> Response:
+        """``GET /models.json``: the registry's retained versions plus the
+        live one -- the operator's rollback menu."""
+        with self._lock:
+            current = self.model_version
+        try:
+            versions = [
+                {
+                    "version": v.version,
+                    "source": v.source,
+                    "engineInstanceId": v.instance_id,
+                    "createdAt": v.manifest.get("created_at"),
+                    "untilMs": v.manifest.get("until_ms"),
+                }
+                for v in self.registry().versions()
+            ]
+        except Exception as exc:
+            return Response(500, {"message": f"registry unavailable: {exc}"})
+        return Response(
+            200, {"currentVersion": current, "versions": versions}
+        )
 
     def handle_reload(self, request: Request) -> Response:
         # /reload re-resolves the LATEST completed instance (hot-swap), even
-        # if the server was started pinned to an explicit instance id
+        # if the server was started pinned to an explicit instance id OR a
+        # registry version -- un-pin both, or a pinned deploy would re-load
+        # its startup version forever (and a GC'd one would 500 here)
         self.requested_instance_id = None
+        self.requested_model_version = None
         self._load_models()
         return Response(200, {"status": "reloaded", "engineInstanceId": self.instance.id})
 

@@ -390,7 +390,11 @@ class TestBatchedQueryServer:
             results = service._predict_batch(
                 [{"user": "u1"}, {"user": "u2", "boom": True}, {"user": "u3"}]
             )
-            assert results[0] == {"rating": pytest.approx(3.0, abs=2.0)}
+            # non-error slots are (result, model_version) -- the epoch the
+            # batch was scored under (None = plain instance deploy)
+            assert results[0] == (
+                {"rating": pytest.approx(3.0, abs=2.0)}, None
+            )
             assert isinstance(results[1], ValueError)
             assert results[2] == results[0]
         finally:

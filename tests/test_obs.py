@@ -1137,7 +1137,9 @@ pio_serving_batch_size_count %d
         frame = render([stats], [snap(102.0, 200, 150)])
         assert "WKR" in frame
         row = next(l for l in frame.splitlines() if "http://x:1" in l)
-        assert row.rstrip().endswith("2")
+        # WKR sits 4th from the end since the continuous-learning columns
+        # (MODEL/SWAP/LAG, dashes here) landed after it
+        assert row.split()[-4] == "2"
 
     def test_parse_prometheus(self):
         from predictionio_tpu.obs.top import parse_prometheus
