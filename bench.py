@@ -33,7 +33,14 @@ so later wedged rounds can still report the last known TPU number + date.
 Env knobs: PIO_BENCH_DEADLINE_S (parent deadline, default 480),
 PIO_BENCH_PROBE_BUDGET_S (TPU probe timeout, default 120, capped at 120),
 PIO_BENCH_SCALE (edge-count divisor for the full-scale phase, default 1),
-PIO_BENCH_PLATFORM=cpu (skip the TPU probe entirely).
+PIO_BENCH_PLATFORM=cpu (skip the TPU probe entirely),
+PIO_BENCH_ALS_FEED=resident|streamed (the ALS data feed: resident holds
+the whole padded edge set in memory -- the historical path, capped near
+20M edges on this box -- while streamed runs device-resident epochs over
+the ``parallel.stream`` block store with O(block) host memory),
+PIO_BENCH_EDGES (absolute edge-count override; counts past ~40M require
+the streamed feed -- this is the 20M-cap lift, see tools/als_stream_bench
+for the standalone >=100M acceptance run).
 """
 
 from __future__ import annotations
@@ -156,6 +163,104 @@ def run_als(platform: str, data, config, iters_to_time: int) -> float:
             " s/iter -- inconsistent beyond tunnel-jitter tolerance"
         )
     return per_iter
+
+
+def run_als_streamed(platform: str, config, n_edges, n_users, n_items,
+                     iters_to_time: int) -> tuple[float, dict]:
+    """Streamed-feed counterpart of ``run_als``: a chunked synthetic
+    source builds the ``parallel.stream`` block store once (disk-cached,
+    O(block) host memory), a 1-iteration fit warms every program, then a
+    timed fit of ``iters_to_time`` chained iterations runs the real
+    steady state -- each iteration re-streams its blocks host->device
+    (that cost is the thing being measured; the resident path instead
+    holds O(edges) in memory). Returns ``(sec_per_iter, extras)`` with
+    the measured-vs-modeled transfer evidence."""
+    import dataclasses
+    import tempfile
+
+    from predictionio_tpu.parallel.als import als_fit_streamed
+    from predictionio_tpu.parallel.stream import (
+        StreamStats,
+        build_streamed_als_data,
+        reship_bytes_per_half_step,
+        stream_bytes_per_half_step,
+    )
+    from predictionio_tpu.tools.als_stream_bench import (
+        chunked_synthetic_source,
+    )
+
+    import jax
+    import numpy as np
+
+    devices = jax.devices(platform)
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(devices[:1]).reshape(1, 1), ("data", "model"))
+    source = chunked_synthetic_source(
+        n_edges, n_users, n_items, implicit=False
+    )
+    cache = os.environ.get("PIO_BENCH_STREAM_CACHE")
+    tmp_ctx = None
+    if cache is None:
+        tmp_ctx = tempfile.TemporaryDirectory(prefix="pio-bench-stream-")
+        cache = tmp_ctx.name
+    try:
+        t0 = time.time()
+        data = build_streamed_als_data(
+            source, n_users, n_items, config, cache
+        )
+        build_s = time.time() - t0
+        warm = dataclasses.replace(config, iterations=1)
+        t0 = time.time()
+        als_fit_streamed(data, warm, mesh)
+        compile_s = time.time() - t0
+        timed = dataclasses.replace(config, iterations=iters_to_time)
+        stats = StreamStats()
+        t0 = time.time()
+        model = als_fit_streamed(data, timed, mesh, stats=stats)
+        float(model.user_factors[0, 0])  # host sync (host model already)
+        sec = (time.time() - t0) / iters_to_time
+        itemsize = 2 if config.dtype == "bfloat16" else 4
+        from predictionio_tpu.ops.als_gram import half_step_bytes
+        from predictionio_tpu.parallel.als import resolve_solver
+
+        fused = resolve_solver(config.solver, platform) == "pallas"
+        specs = [
+            s for side in (data.by_row, data.by_col) for s in side.specs
+        ]
+        extras = {
+            "feed": "streamed",
+            "flops_per_iter_model": sum(
+                _half_step_flops(s.rows, s.pad_len, config.rank)
+                for s in specs
+            ),
+            "bytes_per_iter_model": sum(
+                half_step_bytes(s.rows, s.pad_len, config.rank, itemsize,
+                                fused)
+                for s in specs
+            ),
+            "build_seconds": round(build_s, 2),
+            "compile_and_first_iter_s": round(compile_s, 2),
+            "real_edges": data.real_edges,
+            "blocks": len(data.by_row.specs) + len(data.by_col.specs),
+            "edges_per_sec": round(data.real_edges / sec, 1),
+            "h2d_bytes_per_half_step": stats.bytes_per_half_step,
+            "h2d_modeled_bytes_per_half_step": stream_bytes_per_half_step(
+                data, config.implicit
+            ),
+            "reship_bytes_per_half_step": reship_bytes_per_half_step(
+                data, config.rank, itemsize
+            ),
+            "max_inflight_blocks": stats.max_inflight_blocks,
+        }
+        EVIDENCE["runs"][platform] = {
+            "device": str(devices[0]), "sec_per_iter": round(sec, 5),
+            **extras,
+        }
+        return sec, extras
+    finally:
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
 
 
 def _half_step_flops(rows: int, pad_len: float, rank: int) -> float:
@@ -614,6 +719,50 @@ def secondary_main(result_path: str) -> None:
             " sqlite, rank 8)",
         }
 
+    def als_stream():
+        """#14: device-resident streamed epochs vs the resident feed at an
+        equal (small) shape: edges/sec per arm, bit-identity of the
+        factors, and the transfer axis -- measured host->device bytes per
+        half-step vs the stream model vs the re-ship baseline (the >=3x
+        claim). PIO_BENCH_ALS_FEED pins one arm. The >=100M-edge scaling
+        run is `python -m predictionio_tpu.tools.als_stream_bench --edges
+        100000000` (deliberately NOT run here: it owns the whole budget)."""
+        from predictionio_tpu.tools.als_stream_bench import run_ab
+
+        feed = os.environ.get("PIO_BENCH_ALS_FEED", "both")
+        if feed == "resident":
+            feed_arg = "resident"
+        elif feed == "streamed":
+            feed_arg = "streamed"
+        else:
+            feed_arg = "both"
+        rep = run_ab(
+            edges=1_500_000 if tpu else 400_000,
+            users=40_000 if tpu else 12_000,
+            items=8_000 if tpu else 3_000,
+            iterations=3,
+            feed=feed_arg,
+        )
+        out = {"config": "#14 als_stream (implicit, buckets=2, rank 16)"}
+        for arm in ("resident", "streamed"):
+            if arm in rep:
+                out[f"eps_{arm}"] = rep[arm]["edges_per_sec"]
+        if "streamed" in rep:
+            s = rep["streamed"]
+            out["h2d_bytes_per_half_step"] = s["h2d_bytes_per_half_step"]
+            out["h2d_modeled_bytes_per_half_step"] = s[
+                "h2d_modeled_bytes_per_half_step"
+            ]
+            out["reship_bytes_per_half_step"] = s["reship_bytes_per_half_step"]
+            out["reship_ratio"] = s["reship_ratio"]
+            out["max_inflight_blocks"] = s["max_inflight_blocks"]
+        if "factors_identical" in rep:
+            out["factors_identical"] = rep["factors_identical"]
+            out["factors_equivalent"] = rep["factors_equivalent"]
+        if "streamed_vs_resident_eps" in rep:
+            out["streamed_vs_resident_eps"] = rep["streamed_vs_resident_eps"]
+        return out
+
     phase("naive_bayes_fit", nb_fit)
     phase("logreg_lbfgs_fit", logreg_fit)
     phase("cooccurrence_llr_indicators", cooc_indicators)
@@ -624,6 +773,7 @@ def secondary_main(result_path: str) -> None:
     phase("als_half_step_gbps", als_half_step_gbps)
     phase("trace_overhead_pct", trace_overhead_pct)
     phase("serving_qps_multiproc", serving_qps_multiproc)
+    phase("als_stream", als_stream)
     phase("analysis_findings", analysis_findings)
     phase("online_freshness_seconds", online_freshness)
 
@@ -655,7 +805,23 @@ def child_main(mode: str, result_path: str) -> None:
     n_users = int(N_USERS_FULL / max(scale ** 0.5, 1))
     n_items = int(N_ITEMS_FULL / max(scale ** 0.5, 1))
     n_edges = int(N_EDGES_FULL / scale)
-    users, items, ratings = make_dataset(n_edges, n_users, n_items)
+    feed = os.environ.get("PIO_BENCH_ALS_FEED", "resident")
+    env_edges = os.environ.get("PIO_BENCH_EDGES")
+    if env_edges:
+        # absolute override -- the 20M-cap lift. Entity counts scale like
+        # the generator's ML-20M ratios.
+        n_edges = int(env_edges)
+        grow = max(n_edges / N_EDGES_FULL, 1.0) ** 0.5
+        n_users = int(N_USERS_FULL * grow)
+        n_items = int(N_ITEMS_FULL * grow)
+    if feed not in ("resident", "streamed"):
+        raise SystemExit(f"PIO_BENCH_ALS_FEED must be resident|streamed, got {feed!r}")
+    if feed == "resident" and n_edges > 40_000_000:
+        raise SystemExit(
+            f"{n_edges} edges exceed the resident feed's memory envelope "
+            "on this box; set PIO_BENCH_ALS_FEED=streamed (device-resident "
+            "epochs, O(block) host memory)"
+        )
     # TPU runs the TPU-native layout: bf16 factor storage (half the HBM
     # traffic on gathers, native MXU input dtype), f32 Gram accumulation
     # and solve -- measured 2.1x faster per iteration than f32 storage at
@@ -678,8 +844,6 @@ def child_main(mode: str, result_path: str) -> None:
         # either path for A/B runs
         solver=os.environ.get("PIO_BENCH_ALS_SOLVER", "auto"),
     )
-    data = build_als_data(users, items, ratings, n_users, n_items, config)
-
     # the probed accelerator need not be literally named "tpu" (the axon
     # tunnel backend registers platform "axon"); the parent forwards the
     # probe's actual platform name
@@ -687,24 +851,38 @@ def child_main(mode: str, result_path: str) -> None:
         platform = os.environ.get("PIO_BENCH_TPU_PLATFORM", "tpu")
     else:
         platform = "cpu"
-    # fast TPU iterations need more reps per timed block so the one
-    # scalar-fetch sync (tunnel RTT) amortizes out; CPU iterations are
-    # seconds each and 2 suffice
-    sec = run_als(platform, data, config, 20 if mode == "tpu" else 2)
     from predictionio_tpu.parallel.als import resolve_solver
 
     solver_used = resolve_solver(config.solver, platform)
     itemsize = 2 if config.dtype == "bfloat16" else 4
+    # fast TPU iterations need more reps per timed block so the one
+    # scalar-fetch sync (tunnel RTT) amortizes out; CPU iterations are
+    # seconds each and 2 suffice
+    iters_to_time = 20 if mode == "tpu" else 2
+    extras: dict = {"feed": feed}
+    if feed == "streamed":
+        sec, extras = run_als_streamed(
+            platform, config, n_edges, n_users, n_items, iters_to_time
+        )
+        flops = extras.pop("flops_per_iter_model", 0.0)
+        bytes_iter = extras.pop("bytes_per_iter_model", 0.0)
+    else:
+        users, items, ratings = make_dataset(n_edges, n_users, n_items)
+        data = build_als_data(users, items, ratings, n_users, n_items, config)
+        sec = run_als(platform, data, config, iters_to_time)
+        flops = als_flops_per_iteration(data, config.rank)
+        bytes_iter = als_bytes_per_iteration(
+            data, config.rank, itemsize, fused=solver_used == "pallas"
+        )
     out = {
         "mode": mode,
         "scale": scale,
         "edges": n_edges,
         "sec_per_iter": sec,
-        "flops_per_iter": als_flops_per_iteration(data, config.rank),
+        "flops_per_iter": flops,
         "solver": solver_used,
-        "bytes_per_iter": als_bytes_per_iteration(
-            data, config.rank, itemsize, fused=solver_used == "pallas"
-        ),
+        "bytes_per_iter": bytes_iter,
+        **extras,
         "run_record": EVIDENCE["runs"].get(platform),
         "elapsed_s": round(time.time() - t0, 1),
     }

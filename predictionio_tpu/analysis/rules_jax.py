@@ -530,4 +530,138 @@ class RuleJ005:
         )
 
 
-RULES = (RuleJ001, RuleJ002, RuleJ003, RuleJ004, RuleJ005)
+class RuleJ006:
+    """Loop-invariant host->device transfer inside a training loop.
+    Incident (PR 10, device-resident epochs): ``fold_in_users`` re-shipped
+    the FROZEN item-factor table to the device on every retrain cycle, and
+    the first draft of the streamed ALS epoch loop would have re-shipped
+    the opposite-side factor table / YtY Gram / ridge eye per block. A
+    ``device_put``/``jnp.asarray``/``put_global`` whose argument the loop
+    body never rebinds pays the host link (plus an allocation) once per
+    iteration for bytes that never change -- hoist it above the loop (or
+    cache the device copy, ``online.foldin._device_factors``). Per-batch
+    transfers (the argument is sliced/rebound inside the loop) are the
+    intended shape and stay silent, as do calls inside jitted scopes
+    (tracers make them no-ops)."""
+
+    rule_id = "J006"
+    severity = "warning"
+
+    _PUTS = {
+        "jax.device_put", "device_put", "jnp.asarray", "jax.numpy.asarray",
+        "put_global",
+    }
+    #: a loop is a TRAINING loop when its body calls something step-shaped;
+    #: generic serving/IO loops stay out of scope. Deliberately NO
+    #: `update`: `dict.update()`/`set.update()` in ordinary loops would
+    #: misclassify them (optax-style `opt.update` loops call a step/fit
+    #: function too, so coverage survives)
+    _TRAIN_CALL_RE = re.compile(
+        r"(^|[._])(step|iteration|train|fit|solve|fold)", re.IGNORECASE
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        index = _jit_index(ctx)
+        traced = {id(fn) for fn, _ in index.jitted.values()}
+        traced |= set(index.kernels.keys())
+        # one pass: every loop under a traced def (jitted / kernel) runs
+        # on tracers, where the 'transfer' is a no-op
+        traced_loops: set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(node) in traced:
+                    for n in ast.walk(node):
+                        if isinstance(n, (ast.For, ast.While, ast.AsyncFor)):
+                            traced_loops.add(id(n))
+        reported: set[int] = set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            if id(loop) in traced_loops:
+                continue
+            if not self._is_training_loop(loop):
+                continue
+            bound = self._bound_names(loop)
+            for call in walk_calls(loop):
+                if call.lineno in reported:
+                    continue
+                name = call_name(call)
+                if name not in self._PUTS or not call.args:
+                    continue
+                root = self._root_name(call.args[0])
+                if root is None or root in bound:
+                    continue
+                reported.add(call.lineno)
+                yield Finding(
+                    self.rule_id, self.severity, ctx.path, call.lineno,
+                    ctx.symbol_for(call),
+                    f"`{name}({root}...)` inside a training loop, but "
+                    f"{root!r} is never rebound in the loop body: a "
+                    "loop-invariant host->device transfer per iteration",
+                    "hoist the transfer above the loop (put once, reuse "
+                    "the device array across iterations)",
+                )
+
+    def _is_training_loop(self, loop) -> bool:
+        for call in walk_calls(loop):
+            name = call_name(call)
+            if name in self._PUTS:
+                continue
+            if self._TRAIN_CALL_RE.search(name or ""):
+                return True
+        return False
+
+    def _bound_names(self, loop) -> set[str]:
+        """Names (re)bound anywhere inside the loop, including its own
+        targets: transfers of these are per-iteration by construction."""
+        bound: set[str] = set()
+
+        def add_target(t: ast.AST) -> None:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    bound.add(n.id)
+
+        if isinstance(loop, (ast.For, ast.AsyncFor)):
+            add_target(loop.target)
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    add_target(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.NamedExpr)):
+                add_target(node.target)
+            elif isinstance(node, (ast.For, ast.AsyncFor)) and node is not loop:
+                add_target(node.target)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        add_target(item.optional_vars)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(node.name)
+                for p in (node.args.posonlyargs + node.args.args
+                          + node.args.kwonlyargs):
+                    bound.add(p.arg)
+        return bound
+
+    #: wrappers to see through: device_put(np.asarray(x)) is still a
+    #: transfer of x
+    _UNWRAP = _PUTS | {"np.asarray", "numpy.asarray", "np.array",
+                       "numpy.array"}
+
+    def _root_name(self, expr: ast.AST) -> str | None:
+        """The root variable of a bare Name / dotted attribute argument
+        (seeing through asarray-style wrappers); subscripts and literals
+        are per-iteration values and return None."""
+        while (
+            isinstance(expr, ast.Call)
+            and call_name(expr) in self._UNWRAP
+            and expr.args
+        ):
+            expr = expr.args[0]
+        while isinstance(expr, ast.Attribute):
+            expr = expr.value
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+
+RULES = (RuleJ001, RuleJ002, RuleJ003, RuleJ004, RuleJ005, RuleJ006)

@@ -723,6 +723,16 @@ class SnapshotStore:
                     pass
 
 
+def snapshot_block_dir(snapshot: Snapshot) -> str:
+    """Default home of a generation's streamed-ALS block caches
+    (``parallel.stream``). Living INSIDE the generation directory ties
+    the cache's lifetime to its source data: snapshot GC reaps the cache
+    with the generation, and a refreshed generation starts clean. Extra
+    files here never affect generation validation -- ``_validate`` checks
+    only the manifest-named column files."""
+    return os.path.join(snapshot.path, "blocks")
+
+
 def _file_crc(path: str, bufsize: int = 1 << 20) -> int:
     crc = 0
     with open(path, "rb", buffering=0) as f:

@@ -95,6 +95,22 @@ def _load_categories(app_name: str, channel_name=None) -> dict[str, list[str]]:
     }
 
 
+def _category_index(
+    categories: dict[str, list[str]], item_index: dict[str, int]
+) -> dict[str, np.ndarray]:
+    """category -> sorted item indices: the inverted index behind the
+    query-time ``categories`` filter (shared by train and fold-in)."""
+    by_cat: dict[str, list[int]] = {}
+    for item_id, cats in categories.items():
+        j = item_index.get(item_id)
+        if j is not None:
+            for c in cats:
+                by_cat.setdefault(str(c), []).append(j)
+    return {
+        c: np.asarray(sorted(js), dtype=np.int64) for c, js in by_cat.items()
+    }
+
+
 class ECommerceDataSource(DataSource):
     """Params: appName (required), eventNames (default ["view", "buy"]),
     buyEvents (exact event names carrying purchase-strength confidence,
@@ -305,12 +321,6 @@ class ECommAlgorithm(TPUAlgorithm):
         streamed = getattr(data, "streamed", False)
         seen = {} if streamed else build_seen(data.users, data.items)
         item_index = {iid: j for j, iid in enumerate(data.item_ids)}
-        by_cat: dict[str, list[int]] = {}
-        for item_id, cats in data.categories.items():
-            j = item_index.get(item_id)
-            if j is not None:
-                for c in cats:
-                    by_cat.setdefault(str(c), []).append(j)
         return ECommerceModel(
             als=model,
             app_name=self.params.get_or("appName", None) or data.app_name,
@@ -318,9 +328,7 @@ class ECommAlgorithm(TPUAlgorithm):
             item_ids=data.item_ids,
             item_index=item_index,
             seen=seen,
-            category_items={
-                c: np.asarray(sorted(js), dtype=np.int64) for c, js in by_cat.items()
-            },
+            category_items=_category_index(data.categories, item_index),
             similar_events=self.params.get_or("similarEvents", ["view"]),
             seen_mode="live" if streamed else "model",
             channel_name=getattr(data, "channel_name", None),
@@ -332,10 +340,15 @@ class ECommAlgorithm(TPUAlgorithm):
     def fold_in(self, model: ECommerceModel, delta) -> ECommerceModel | None:
         """Continuous-learning hook: implicit fold-in of the delta window
         (frozen item factors, per-event confidences from the datasource's
-        map riding ``delta.extras``). New items carry zero factors AND no
-        category entries until the next full retrain (categories come from
-        a ``$set`` aggregate the loop does not rescan) -- the staleness
-        budget's item-growth bound caps both forms of staleness at once."""
+        map riding ``delta.extras``). New items carry zero factors until
+        the next full retrain (the staleness budget's item-growth bound
+        caps that); the CATEGORY index no longer waits that long -- when
+        the window's touched events include item ``$set`` records, the
+        ``$set`` aggregate is rescanned and the inverted index rebuilt
+        against the (possibly just-extended) item vocabulary, so a
+        category change is serveable one fold-in cycle later. A window of
+        ONLY ``$set`` records still publishes: the factor core passes
+        through unchanged with a fresh index."""
         from predictionio_tpu.online.foldin import fold_in_als_model
 
         event_values = delta.extras.get("event_values") or {}
@@ -348,10 +361,26 @@ class ECommAlgorithm(TPUAlgorithm):
             self._config(),
             event_values=event_values,
         )
-        if result is None:
+        refresh_categories = "item" in (
+            getattr(delta, "set_entity_types", None) or ()
+        )
+        if result is None and not refresh_categories:
             return None
+        item_index = result.item_index if result else model.item_index
+        category_items = model.category_items
+        if refresh_categories:
+            category_items = _category_index(
+                _load_categories(
+                    model.app_name, getattr(model, "channel_name", None)
+                ),
+                item_index,
+            )
         seen = model.seen
-        if getattr(model, "seen_mode", "model") == "model" and result.window_pairs is not None:
+        if (
+            result is not None
+            and getattr(model, "seen_mode", "model") == "model"
+            and result.window_pairs is not None
+        ):
             seen = {u: set(s) for u, s in model.seen.items()}
             for u, i in result.window_pairs.tolist():
                 seen.setdefault(int(u), set()).add(int(i))
@@ -359,10 +388,11 @@ class ECommAlgorithm(TPUAlgorithm):
 
         return dataclasses.replace(
             model,
-            als=result.als,
-            user_index=result.user_index,
-            item_ids=result.item_ids,
-            item_index=result.item_index,
+            als=result.als if result else model.als,
+            user_index=result.user_index if result else model.user_index,
+            item_ids=result.item_ids if result else model.item_ids,
+            item_index=item_index,
+            category_items=category_items,
             seen=seen,
         )
 

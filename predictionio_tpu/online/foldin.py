@@ -106,6 +106,11 @@ class FoldinDelta:
     #: template's per-event confidence map) -- DASE keeps per-component
     #: params separate, so the loop forwards them here
     extras: dict = field(default_factory=dict)
+    #: entity types that received ``$set``/``$unset``/``$delete`` records
+    #: in this window (from the WAL tail): algorithms deriving state from
+    #: a property aggregate (the e-commerce category index) rescan it
+    #: instead of serving the stale index until a full retrain
+    set_entity_types: set | None = None
 
 
 def _pow2_ceil(n: int, floor: int = 8) -> int:
@@ -113,6 +118,40 @@ def _pow2_ceil(n: int, floor: int = 8) -> int:
     while out < n:
         out *= 2
     return out
+
+
+#: id(host array) -> (weakref, device copy). Tiny by construction: the
+#: retrain loop holds a handful of live factor tables at once.
+_DEVICE_FACTOR_CACHE: dict = {}
+
+
+def _device_factors(item_factors: np.ndarray):
+    """Device copy of the frozen item factors, cached across fold-in
+    cycles. Between full retrains the item table is REPLACED, never
+    mutated (``fold_in_als_model`` vstacks a new array when items grow,
+    else passes the same object through), so object identity is a sound
+    cache key -- and without the cache every ``pio retrain --follow``
+    cycle re-shipped the model's largest array to the device to solve a
+    handful of touched rows (the J006 loop-invariant-transfer shape,
+    hoisted here because the "loop" spans run_once calls rather than a
+    lexical ``for``). The weakref guards id() reuse after GC."""
+    import weakref
+
+    import jax
+
+    key = id(item_factors)
+    hit = _DEVICE_FACTOR_CACHE.get(key)
+    if hit is not None and hit[0]() is item_factors:
+        return hit[1]
+    # prune DEAD entries only (host array GC'd): a bulk clear at a count
+    # threshold would pin up to N dead device tables until it fired AND
+    # evict the live hot entry with them -- on an accelerator that is HBM
+    # held by garbage plus a forced full re-ship next cycle
+    for k in [k for k, (ref, _) in _DEVICE_FACTOR_CACHE.items() if ref() is None]:
+        del _DEVICE_FACTOR_CACHE[k]
+    dev = jax.device_put(np.asarray(item_factors, np.float32))
+    _DEVICE_FACTOR_CACHE[key] = (weakref.ref(item_factors), dev)
+    return dev
 
 
 @functools.lru_cache(maxsize=16)
@@ -210,7 +249,8 @@ def fold_in_users(
         csr.indices,
         csr.values,
         csr.mask.sum(axis=1).astype(np.float32),
-        np.asarray(item_factors, np.float32),
+        # hoisted: the frozen table ships once, not once per cycle
+        _device_factors(item_factors),
         np.float32(config.reg),
         np.float32(config.alpha),
     )

@@ -95,6 +95,14 @@ class TailBatch:
     records: int = 0               # matching interaction records
     touched_users: set = field(default_factory=set)   # entity ids (strings)
     touched_items: set = field(default_factory=set)   # target ids (strings)
+    #: ``$set``/``$unset``/``$delete`` property records on the followed
+    #: app/channel, by entity TYPE. Property events are not interactions
+    #: (they never enter the snapshot window or the lag clock -- the
+    #: aggregate they change is read LIVE), but a fold-in must know they
+    #: happened: the e-commerce category index comes from the item ``$set``
+    #: aggregate and served stale until the next full retrain before this.
+    set_records: int = 0
+    touched_set_types: set = field(default_factory=set)
     min_event_ms: int | None = None
     max_event_ms: int | None = None
     #: cursor trails the oldest retained segment: records were GC'd before
@@ -103,7 +111,7 @@ class TailBatch:
 
     @property
     def empty(self) -> bool:
-        return self.records == 0 and not self.gap
+        return self.records == 0 and self.set_records == 0 and not self.gap
 
     def lag_seconds(self, now: float | None = None) -> float:
         """Age of the OLDEST event in this unreflected window -- the
@@ -164,6 +172,14 @@ class WalTail:
                 )
                 continue
             if app_id != self.app_id or channel_id != self.channel_id:
+                continue
+            if event.event.startswith("$"):
+                # property records ($set/$unset/$delete): tracked by
+                # entity type so fold-in can refresh property-derived
+                # indexes (e.g. e-commerce categories); never counted as
+                # interactions and never part of the snapshot window
+                batch.set_records += 1
+                batch.touched_set_types.add(event.entity_type)
                 continue
             if self.event_names is not None and event.event not in self.event_names:
                 continue

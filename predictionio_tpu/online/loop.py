@@ -234,6 +234,7 @@ class RetrainLoop:
             touched_user_ids=set(batch.touched_users) or None,
             budget=self.config.budget,
             extras=dict(getattr(self.handle, "extras", None) or {}),
+            set_entity_types=set(batch.touched_set_types) or None,
         )
         try:
             if not all(

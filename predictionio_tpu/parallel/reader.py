@@ -328,6 +328,53 @@ def snapshot_multi_event_chunks(
     return {n: source_for(n) for n in event_names}, users_enc, items_enc
 
 
+def snapshot_streamed_als_data(
+    snapshot,
+    config: ALSConfig,
+    cache_dir: str | None = None,
+    mesh=None,
+    model_shards: int = 1,
+    chunk_rows: int = 262_144,
+    default_value: float = 1.0,
+    event_values: dict[str, float] | None = None,
+    block_rows: int | None = None,
+    block_bytes: int | None = None,
+) -> tuple[IncrementalEncoder, IncrementalEncoder, object]:
+    """Streamed-epoch block store fed straight from a columnar snapshot.
+
+    The PR-3 memmap columns are exactly the right on-disk feed for ALX
+    device-resident epochs: the two build passes (counts, spill) replay
+    the local memmaps instead of SQL, and the packed blocks land under
+    the snapshot GENERATION directory by default (``data.snapshot.
+    snapshot_block_dir``), so snapshot GC reaps a stale block cache with
+    its generation and a refreshed generation re-packs. Returns
+    ``(users_enc, items_enc, StreamedALSData)`` with the encoders
+    pre-filled exactly like :func:`snapshot_coo_chunks` -- feed the data
+    to ``parallel.als.als_fit_streamed``.
+    """
+    from predictionio_tpu.data.snapshot import snapshot_block_dir
+    from predictionio_tpu.parallel.stream import (
+        DEFAULT_BLOCK_BYTES,
+        build_streamed_als_data,
+    )
+
+    source, users_enc, items_enc = snapshot_coo_chunks(
+        snapshot, chunk_rows, default_value, event_values
+    )
+    data = build_streamed_als_data(
+        source,
+        len(users_enc.vocab),
+        len(items_enc.vocab),
+        config,
+        cache_dir or snapshot_block_dir(snapshot),
+        num_shards=int(mesh.shape["data"]) if mesh is not None else 1,
+        model_shards=model_shards,
+        block_rows=block_rows,
+        block_bytes=block_bytes or DEFAULT_BLOCK_BYTES,
+    )
+    return users_enc, items_enc, data
+
+
 def universe_pass(sources: dict[str, ChunkSource]) -> None:
     """Drive one full scan through the shared encoders so the entity
     universe (len(encoder.ids)) is known before any per-type build.

@@ -1,0 +1,665 @@
+"""Streamed padded-CSR block store: the host side of device-resident epochs.
+
+ALX (arxiv 2112.02194) structures a TPU matrix-factorization epoch as
+"factor tables resident in HBM, row blocks streamed in asynchronously".
+The resident half lives in ``parallel.als.als_fit_streamed``; this module
+is the streaming half -- it turns an unordered COO chunk stream (the PR-3
+snapshot memmap replay, a SQL chunk scan, or a synthetic generator) into
+an on-disk cache of **packed padded-CSR row blocks** that an epoch can
+replay with O(block) host memory:
+
+1. **plan** -- one counting pass derives both sides' bucket plans exactly
+   like ``build_als_data`` (same ``_plan_buckets``, same slot maps), then
+   each bucket's padded row range is cut into fixed-height blocks;
+2. **spill** -- one partitioning pass appends every edge to its (side,
+   block) spill file in stream order. Disk holds O(edges); the host holds
+   one chunk;
+3. **pack** -- each block's spill packs through ``pack_padded_csr``
+   (identical per-row layout to the resident build: same stream order,
+   same truncation, same padded length) and lands as raw ``int32`` index /
+   ``float32`` value / ``float32`` n_obs files. The ``[rows, L]`` host
+   intermediate for a whole side never exists -- only one block's worth.
+
+**Uniform-value elision**: most implicit-feedback streams carry one
+constant value (views = 1.0). A block whose real entries are all equal
+stores no value file at all; the epoch driver re-materializes
+``full(cval)`` on device. That is exact, not approximate: padding slots'
+indices point at the appended zero factor row, so every padding term
+multiplies a zero vector and the value there is don't-care (the
+``parallel.als`` padding invariant). At ML-scale this halves the
+host->device stream (indices only).
+
+The feeder (:func:`prefetch_blocks`, driven by ``als_fit_streamed``'s
+``feed``) is a prefetch-1 generator: block N+1 is read from disk and
+``device_put`` while the device still computes block N (JAX's async
+dispatch keeps the transfer in flight under the compute), and at most two
+host blocks are ever alive -- the peak-RSS bound the regression tests pin
+via :class:`FeedAccounting`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.ops.ragged import pack_padded_csr
+from predictionio_tpu.parallel.als import _plan_buckets
+
+#: bump on any incompatible change to block files or the manifest
+STREAM_FORMAT_VERSION = 1
+
+#: default packed-block height target in bytes (idx + val streams); the
+#: actual height is per bucket: ``block_bytes // (L * 8)`` rounded down to
+#: the row multiple. 32 MB keeps a 2-core box's resident set small while
+#: amortizing per-block dispatch overhead.
+DEFAULT_BLOCK_BYTES = 32 * 1024 * 1024
+
+_SPILL_TIMES = np.dtype([("r", "<i4"), ("c", "<i4"), ("v", "<f4"), ("t", "<f8")])
+_SPILL_PLAIN = np.dtype([("r", "<i4"), ("c", "<i4"), ("v", "<f4")])
+
+
+@dataclass(frozen=True)
+class BlockSpec:
+    """One packed block: rows ``[offset, offset + rows)`` of a side's
+    factor table, padded length ``pad_len`` (its bucket's L)."""
+
+    index: int          # block number within the side
+    bucket: int
+    offset: int         # first factor slot (global within the side)
+    rows: int           # padded rows (multiple of the layout row multiple)
+    pad_len: int
+    #: every real entry carries this value (value stream elided); None =
+    #: mixed values, a value file exists
+    const: float | None = None
+    edges: int = 0      # real (mask=1) entries in the block
+    truncated: int = 0
+
+    def idx_bytes(self) -> int:
+        return self.rows * self.pad_len * 4
+
+    def val_bytes(self) -> int:
+        return 0 if self.const is not None else self.rows * self.pad_len * 4
+
+    def nobs_bytes(self) -> int:
+        return self.rows * 4
+
+
+@dataclass
+class StreamedSide:
+    """One orientation's block store. Duck-types the ``BucketedCSR``
+    surface ``als_fit``'s init/readback needs (``slot_of``, ``num_rows``,
+    ``total_slots``) without ever materializing the side."""
+
+    name: str                 # "u" | "i"
+    directory: str
+    specs: list[BlockSpec]
+    slot_of: np.ndarray       # original entity id -> factor slot
+    num_rows: int             # real entities
+    total_slots: int
+    global_rows: None = None  # streamed sides are always process-global
+
+    @property
+    def real_edges(self) -> int:
+        return sum(s.edges for s in self.specs)
+
+    @property
+    def truncated(self) -> int:
+        return sum(s.truncated for s in self.specs)
+
+    @property
+    def padded_slots(self) -> int:
+        return sum(s.rows * s.pad_len for s in self.specs)
+
+    def _path(self, spec: BlockSpec, kind: str) -> str:
+        return os.path.join(
+            self.directory, f"{self.name}-{spec.index:05d}.{kind}.bin"
+        )
+
+    def load_block(
+        self, spec: BlockSpec
+    ) -> tuple[np.ndarray, np.ndarray | None, np.ndarray]:
+        """Read one packed block: ``(indices i32 [rows, L], values f32
+        [rows, L] or None when const, n_obs f32 [rows])``. ``np.fromfile``
+        (not memmap): the copy is freed when the caller drops it, so the
+        feeder's two-block residency bound is a real RSS bound."""
+        idx = np.fromfile(self._path(spec, "idx"), dtype=np.int32)
+        idx = idx.reshape(spec.rows, spec.pad_len)
+        if spec.const is None:
+            val = np.fromfile(self._path(spec, "val"), dtype=np.float32)
+            val = val.reshape(spec.rows, spec.pad_len)
+        else:
+            val = None
+        nobs = np.fromfile(self._path(spec, "nob"), dtype=np.float32)
+        return idx, val, nobs
+
+
+@dataclass
+class StreamedALSData:
+    """Both orientations as block stores + the layout facts a fit needs."""
+
+    by_row: StreamedSide      # users x items
+    by_col: StreamedSide      # items x users
+    directory: str
+    row_multiple: int
+    manifest: dict = field(default_factory=dict)
+
+    @property
+    def real_edges(self) -> int:
+        return self.by_row.real_edges
+
+
+@dataclass
+class StreamStats:
+    """Measured host->device traffic of one streamed fit -- the evidence
+    behind the bench's achieved-vs-modeled transfer metric."""
+
+    h2d_block_bytes: int = 0   # actually device_put block payloads
+    h2d_scalar_bytes: int = 0  # per-block offsets/consts (noise, reported)
+    half_steps: int = 0
+    blocks_streamed: int = 0
+    blocks_pinned: int = 0
+    pinned_bytes: int = 0
+    max_inflight_blocks: int = 0
+
+    @property
+    def bytes_per_half_step(self) -> float:
+        return self.h2d_block_bytes / max(self.half_steps, 1)
+
+
+# --------------------------------------------------------------------------
+# build
+# --------------------------------------------------------------------------
+
+
+def _side_specs(plan, row_multiple: int, block_rows: int | None,
+                block_bytes: int) -> list[BlockSpec]:
+    """Cut each bucket's padded row range into fixed-height blocks (the
+    last block of a bucket may be shorter; heights stay multiples of the
+    row multiple so every block shards evenly over data*model)."""
+    specs: list[BlockSpec] = []
+    index = 0
+    for bucket, (off, padded, length) in enumerate(
+        zip(plan.offsets, plan.padded_rows, plan.lengths)
+    ):
+        if block_rows is not None:
+            height = max(row_multiple, (block_rows // row_multiple) * row_multiple)
+        else:
+            height = max(
+                row_multiple,
+                (block_bytes // (length * 8)) // row_multiple * row_multiple,
+            )
+        start = 0
+        while start < padded:
+            rows = min(height, padded - start)
+            specs.append(BlockSpec(
+                index=index, bucket=bucket, offset=off + start, rows=rows,
+                pad_len=length,
+            ))
+            index += 1
+            start += rows
+    return specs
+
+
+def _counts_digest(counts: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(counts).tobytes()).hexdigest()[:16]
+
+
+def layout_key(
+    config,
+    row_multiple: int,
+    block_rows: int | None,
+    block_bytes: int,
+    cnt_u: np.ndarray,
+    cnt_i: np.ndarray,
+    edges: int,
+    with_times: bool,
+    content_crc: int = 0,
+) -> str:
+    """Identity of one streamed layout: the bucket plans are a pure
+    function of the counts + packing knobs, and ``content_crc`` (a
+    running checksum of the stream's value AND time bytes) covers what
+    the counts cannot -- the same (user, item) structure packed with
+    different values (an ``event_values`` weight edit, a rating change)
+    or reordered timestamps must never reuse a cached store."""
+    material = json.dumps({
+        "version": STREAM_FORMAT_VERSION,
+        "buckets": max(int(config.buckets), 1),
+        "max_len": config.max_len,
+        "row_multiple": row_multiple,
+        "block_rows": block_rows,
+        "block_bytes": block_bytes,
+        "edges": edges,
+        "users": _counts_digest(cnt_u),
+        "items": _counts_digest(cnt_i),
+        "n_users": int(cnt_u.size),
+        "n_items": int(cnt_i.size),
+        "with_times": with_times,
+        "content_crc": int(content_crc),
+    }, sort_keys=True)
+    return hashlib.sha256(material.encode()).hexdigest()[:16]
+
+
+class _SideSpill:
+    """Partition pass state for one orientation: an append handle per
+    block plus the searchsorted row->block map."""
+
+    def __init__(self, directory: str, name: str, specs: list[BlockSpec],
+                 with_times: bool):
+        self.dir = directory
+        self.name = name
+        self.specs = specs
+        self.starts = np.array([s.offset for s in specs], dtype=np.int64)
+        self.dtype = _SPILL_TIMES if with_times else _SPILL_PLAIN
+        self._files: dict[int, object] = {}
+
+    def _file(self, block: int):
+        f = self._files.get(block)
+        if f is None:
+            f = open(self._spill_path(block), "ab")
+            self._files[block] = f
+        return f
+
+    def _spill_path(self, block: int) -> str:
+        return os.path.join(self.dir, f"{self.name}-{block:05d}.spill")
+
+    def take(self, row_slots, col_slots, vals, times) -> None:
+        block_of = np.searchsorted(self.starts, row_slots, side="right") - 1
+        order = np.argsort(block_of, kind="stable")
+        rec = np.empty(row_slots.size, dtype=self.dtype)
+        rec["r"] = (row_slots - self.starts[block_of]).astype(np.int32)
+        rec["c"] = col_slots.astype(np.int32)
+        rec["v"] = vals
+        if "t" in self.dtype.names:
+            # a timeless chunk in a timed stream must still be
+            # deterministic (pack sorts on this field)
+            rec["t"] = 0.0 if times is None else times
+        rec = rec[order]
+        blocks = block_of[order]
+        bounds = np.nonzero(np.diff(blocks))[0] + 1
+        for lo, hi in zip(
+            np.r_[0, bounds], np.r_[bounds, blocks.size]
+        ):
+            if lo == hi:
+                continue
+            self._file(int(blocks[lo])).write(rec[lo:hi].tobytes())
+
+    def read_and_unlink(self, block: int) -> np.ndarray:
+        f = self._files.pop(block, None)
+        if f is not None:
+            f.close()
+        path = self._spill_path(block)
+        try:
+            rec = np.fromfile(path, dtype=self.dtype)
+        except (OSError, FileNotFoundError):
+            rec = np.empty(0, dtype=self.dtype)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return rec
+
+    def close(self) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+def _pack_side(
+    spill: _SideSpill,
+    specs: list[BlockSpec],
+    directory: str,
+    name: str,
+    opp_total_slots: int,
+    max_len: int | None,
+    row_multiple: int,
+) -> list[BlockSpec]:
+    """Pack every spill file into its block triple; returns specs with
+    const/edge metadata filled. Host memory: one block at a time."""
+    import dataclasses
+
+    out: list[BlockSpec] = []
+    for spec in specs:
+        rec = spill.read_and_unlink(spec.index)
+        times = rec["t"] if "t" in rec.dtype.names and rec.size else None
+        csr = pack_padded_csr(
+            rec["r"].astype(np.int64),
+            rec["c"].astype(np.int64),
+            rec["v"],
+            num_rows=spec.rows,
+            num_cols=opp_total_slots,
+            max_len=max_len,
+            times=times,
+            row_multiple=row_multiple,
+            pad_len=spec.pad_len,
+        )
+        if csr.indices.shape != (spec.rows, spec.pad_len):
+            raise AssertionError(
+                f"packed block shape {csr.indices.shape} != spec "
+                f"({spec.rows}, {spec.pad_len})"
+            )
+        vals = rec["v"]
+        if vals.size == 0:
+            const: float | None = 0.0  # all padding: value is don't-care
+        elif np.all(vals == vals[0]):
+            const = float(vals[0])
+        else:
+            const = None
+        spec = dataclasses.replace(
+            spec,
+            const=const,
+            edges=int(csr.mask.sum()),
+            truncated=int(csr.truncated),
+        )
+        csr.indices.tofile(os.path.join(
+            directory, f"{name}-{spec.index:05d}.idx.bin"))
+        if const is None:
+            csr.values.tofile(os.path.join(
+                directory, f"{name}-{spec.index:05d}.val.bin"))
+        csr.mask.sum(axis=1, dtype=np.float32).tofile(os.path.join(
+            directory, f"{name}-{spec.index:05d}.nob.bin"))
+        out.append(spec)
+    return out
+
+
+def _spec_json(s: BlockSpec) -> dict:
+    return {
+        "index": int(s.index), "bucket": int(s.bucket),
+        "offset": int(s.offset), "rows": int(s.rows),
+        "pad_len": int(s.pad_len),
+        "const": None if s.const is None else float(s.const),
+        "edges": int(s.edges), "truncated": int(s.truncated),
+    }
+
+
+def _side_from_manifest(directory: str, name: str, side: dict) -> StreamedSide:
+    specs = [BlockSpec(**spec) for spec in side["specs"]]
+    slot_of = np.fromfile(
+        os.path.join(directory, f"{name}-slot_of.bin"), dtype=np.int64
+    )
+    return StreamedSide(
+        name=name,
+        directory=directory,
+        specs=specs,
+        slot_of=slot_of,
+        num_rows=int(side["num_rows"]),
+        total_slots=int(side["total_slots"]),
+    )
+
+
+def load_streamed_als_data(directory: str) -> StreamedALSData | None:
+    """Open a committed block store; None when absent/invalid (size-checked
+    per block so a torn build never feeds a fit)."""
+    try:
+        with open(os.path.join(directory, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if manifest.get("format_version") != STREAM_FORMAT_VERSION:
+        return None
+    try:
+        by_row = _side_from_manifest(directory, "u", manifest["u"])
+        by_col = _side_from_manifest(directory, "i", manifest["i"])
+        for side in (by_row, by_col):
+            for spec in side.specs:
+                if os.path.getsize(side._path(spec, "idx")) != spec.idx_bytes():
+                    return None
+                if spec.const is None and os.path.getsize(
+                    side._path(spec, "val")
+                ) != spec.val_bytes():
+                    return None
+                if os.path.getsize(side._path(spec, "nob")) != spec.nobs_bytes():
+                    return None
+    except (OSError, KeyError, TypeError, ValueError):
+        return None
+    return StreamedALSData(
+        by_row=by_row,
+        by_col=by_col,
+        directory=directory,
+        row_multiple=int(manifest["row_multiple"]),
+        manifest=manifest,
+    )
+
+
+def build_streamed_als_data(
+    chunks,
+    num_users: int | None,
+    num_items: int | None,
+    config,
+    cache_dir: str,
+    num_shards: int = 1,
+    model_shards: int = 1,
+    block_rows: int | None = None,
+    block_bytes: int = DEFAULT_BLOCK_BYTES,
+    reuse: bool = True,
+) -> StreamedALSData:
+    """Plan + spill + pack a COO chunk stream into a block store.
+
+    Layout-equivalent to ``build_als_data(..., num_shards, model_shards)``
+    -- same bucket plans, slot maps, padded lengths and per-row packing --
+    so ``als_fit_streamed`` over the result is bit-identical to ``als_fit``
+    over the resident build. Peak host memory is O(chunk + one block),
+    never O(edges); the edge set lives on disk under ``cache_dir``.
+
+    With ``reuse`` (default) a committed store whose layout key matches is
+    loaded instead of rebuilt -- repeat epochs/trains pay zero passes.
+    ``chunks`` is a ``parallel.reader.ChunkSource``: a zero-arg callable
+    yielding ``(users, items, values, times|None)`` arrays; it is iterated
+    twice (counts, spill).
+    """
+    from predictionio_tpu.parallel.reader import _grow_bincount
+
+    rm = 8 * max(num_shards, 1) * max(model_shards, 1)
+    nb = max(int(config.buckets), 1)
+    import zlib
+
+    cnt_u = np.zeros(num_users or 0, dtype=np.int64)
+    cnt_i = np.zeros(num_items or 0, dtype=np.int64)
+    edges = 0
+    with_times = True
+    content_crc = 0
+    for uu, ii, vv, tt in chunks():
+        cnt_u = _grow_bincount(cnt_u, uu)
+        cnt_i = _grow_bincount(cnt_i, ii)
+        edges += int(uu.size)
+        # the endpoint streams must be in the key too: two edge sets with
+        # IDENTICAL degree histograms (e.g. swapped endpoints) but
+        # different pairings pack different matrices
+        content_crc = zlib.crc32(
+            np.ascontiguousarray(uu, np.int64).tobytes(), content_crc
+        )
+        content_crc = zlib.crc32(
+            np.ascontiguousarray(ii, np.int64).tobytes(), content_crc
+        )
+        content_crc = zlib.crc32(
+            np.ascontiguousarray(vv, np.float32).tobytes(), content_crc
+        )
+        if tt is None:
+            with_times = False
+        else:
+            content_crc = zlib.crc32(
+                np.ascontiguousarray(tt, np.float64).tobytes(), content_crc
+            )
+    for side_name, total in (("user", cnt_u.size), ("item", cnt_i.size)):
+        if total >= 2 ** 31:
+            raise ValueError(
+                f"{side_name} universe {total} exceeds the int32 block "
+                "index space"
+            )
+
+    key = layout_key(
+        config, rm, block_rows, block_bytes, cnt_u, cnt_i, edges, with_times,
+        content_crc,
+    )
+    target = os.path.join(cache_dir, f"blocks-{key}")
+    if reuse:
+        cached = load_streamed_als_data(target)
+        if cached is not None:
+            return cached
+
+    plan_u = _plan_buckets(cnt_u, config.max_len, nb, rm)
+    plan_i = _plan_buckets(cnt_i, config.max_len, nb, rm)
+    specs_u = _side_specs(plan_u, rm, block_rows, block_bytes)
+    specs_i = _side_specs(plan_i, rm, block_rows, block_bytes)
+
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = os.path.join(cache_dir, f".tmp-{os.getpid()}-{time.monotonic_ns()}")
+    os.makedirs(tmp)
+    try:
+        spill_u = _SideSpill(tmp, "u", specs_u, with_times)
+        spill_i = _SideSpill(tmp, "i", specs_i, with_times)
+        t0 = time.perf_counter()
+        for uu, ii, vv, tt in chunks():
+            u_slots = plan_u.slot_of[uu]
+            i_slots = plan_i.slot_of[ii]
+            tt = tt if with_times else None
+            spill_u.take(u_slots, i_slots, vv, tt)
+            spill_i.take(i_slots, u_slots, vv, tt)
+        spill_u.close()
+        spill_i.close()
+        spill_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        specs_u = _pack_side(
+            spill_u, specs_u, tmp, "u", plan_i.total_slots, config.max_len, rm
+        )
+        specs_i = _pack_side(
+            spill_i, specs_i, tmp, "i", plan_u.total_slots, config.max_len, rm
+        )
+        plan_u.slot_of.tofile(os.path.join(tmp, "u-slot_of.bin"))
+        plan_i.slot_of.tofile(os.path.join(tmp, "i-slot_of.bin"))
+        manifest = {
+            "format_version": STREAM_FORMAT_VERSION,
+            "layout_key": key,
+            "row_multiple": rm,
+            "edges": edges,
+            "with_times": with_times,
+            "spill_seconds": round(spill_s, 3),
+            "pack_seconds": round(time.perf_counter() - t0, 3),
+            "u": {
+                "specs": [_spec_json(s) for s in specs_u],
+                "num_rows": int(plan_u.slot_of.shape[0]),
+                "total_slots": int(plan_u.total_slots),
+            },
+            "i": {
+                "specs": [_spec_json(s) for s in specs_i],
+                "num_rows": int(plan_i.slot_of.shape[0]),
+                "total_slots": int(plan_i.total_slots),
+            },
+        }
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        # atomic publish; a racing builder of the same key built the
+        # identical layout, so either copy serves. A torn carcass at the
+        # target (crashed earlier build) is replaced.
+        try:
+            os.rename(tmp, target)
+        except OSError:
+            existing = load_streamed_als_data(target)
+            if existing is not None:
+                shutil.rmtree(tmp, ignore_errors=True)
+                return existing
+            shutil.rmtree(target, ignore_errors=True)
+            os.rename(tmp, target)
+        loaded = load_streamed_als_data(target)
+        if loaded is None:
+            raise OSError(f"block store at {target} failed validation")
+        return loaded
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+# --------------------------------------------------------------------------
+# the feeder
+# --------------------------------------------------------------------------
+
+
+class FeedAccounting:
+    """Counts simultaneously-alive host blocks; the regression test pins
+    the two-block bound (prefetch depth 1 + the block being consumed)."""
+
+    def __init__(self) -> None:
+        self.live = 0
+        self.max_live = 0
+
+    def acquire(self) -> None:
+        self.live += 1
+        self.max_live = max(self.max_live, self.live)
+
+    def release(self) -> None:
+        self.live -= 1
+
+
+def prefetch_blocks(specs, produce, on_consumed=None):
+    """Drive ``produce(spec)`` with prefetch depth 1 and yield ``(spec,
+    produced)`` pairs: block N+1's ``produce`` (disk read + async
+    ``device_put``) runs before block N is yielded for compute, so the
+    transfer is in flight under the consumer's kernel. ``on_consumed``
+    fires once the consumer has moved past a block (the release edge of
+    the two-in-flight accounting)."""
+    if not specs:
+        return
+    prev_spec = specs[0]
+    ahead = produce(prev_spec)
+    for nxt in specs[1:]:
+        cur_spec, cur = prev_spec, ahead
+        ahead = produce(nxt)  # N+1's transfer flies while N computes
+        yield cur_spec, cur
+        if on_consumed is not None:
+            on_consumed(cur_spec)  # consumer asked for N+1: N is done
+        prev_spec = nxt
+    yield prev_spec, ahead
+    if on_consumed is not None:
+        on_consumed(prev_spec)
+
+
+# --------------------------------------------------------------------------
+# transfer models (the bench's modeled-vs-measured axis)
+# --------------------------------------------------------------------------
+
+
+def stream_bytes_per_half_step(data: StreamedALSData, implicit: bool) -> float:
+    """Modeled host->device bytes one half-step streams with no pinning:
+    the solved side's index stream + non-uniform value streams (+ n_obs in
+    explicit mode, which needs per-row counts for ALS-WR ridge). Averaged
+    over the two half-steps of an iteration."""
+    total = 0
+    for side in (data.by_row, data.by_col):
+        for s in side.specs:
+            total += s.idx_bytes() + s.val_bytes()
+            if not implicit:
+                total += s.nobs_bytes()
+    return total / 2.0
+
+
+def reship_bytes_per_half_step(
+    data, rank: int, itemsize: int, implicit: bool = False
+) -> float:
+    """The re-ship baseline: what a NON-resident epoch moves host->device
+    per half-step -- both orientations' CSR blocks (index + value + n_obs
+    streams; no elision, values always ship) plus both factor tables
+    re-materialized on device. This is the per-step transfer structure the
+    pre-streaming loop amortized only by holding the whole edge set in
+    device memory -- exactly what stops scaling past HBM/host RAM.
+
+    Accepts ``StreamedALSData`` or the resident ``parallel.als.ALSData``.
+    """
+    del implicit  # the baseline ships n_obs/vals regardless; keep the knob
+    total = 0.0
+    sides = (data.by_row, data.by_col)
+    for side in sides:
+        specs = getattr(side, "specs", None)
+        if specs is not None:
+            shapes = [(s.rows, s.pad_len) for s in specs]
+        else:
+            shapes = [b.indices.shape for b in side.blocks]
+        for rows, length in shapes:
+            total += rows * length * 8 + rows * 4  # idx i32 + val f32 + n_obs
+        total += (side.total_slots + 1) * rank * itemsize  # factor table
+    return total

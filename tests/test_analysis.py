@@ -31,6 +31,7 @@ from predictionio_tpu.analysis.rules_jax import (
     RuleJ003,
     RuleJ004,
     RuleJ005,
+    RuleJ006,
 )
 
 
@@ -342,6 +343,98 @@ class TestJ005:
             def assemble(outs, mesh):
                 row = NamedSharding(mesh, P("data"))
                 return jax.device_put(jnp.concatenate(outs), row)
+        """) == []
+
+
+# -- J006: loop-invariant transfers in training loops -------------------------
+
+class TestJ006:
+    def test_fires_on_invariant_factor_reship(self):
+        # the fold_in_users incident shape: the frozen factor table ships
+        # host->device on every cycle of the retrain loop
+        hits = run_rule(RuleJ006, """
+            import numpy as np
+            import jax
+
+            def retrain_loop(batches, item_factors, step):
+                for batch in batches:
+                    table = jax.device_put(np.asarray(item_factors))
+                    step(batch, table)
+        """)
+        assert [f.rule_id for f in hits] == ["J006"]
+        assert "item_factors" in hits[0].message
+
+    def test_fires_on_jnp_asarray_and_put_global(self):
+        hits = run_rule(RuleJ006, """
+            import jax.numpy as jnp
+
+            def train(epochs, eye, rep, step):
+                for _ in range(epochs):
+                    ridge = jnp.asarray(eye)
+                    step(put_global(rep, None), ridge)
+        """)
+        assert sorted(f.message.split("`")[1] for f in hits) == [
+            "jnp.asarray(eye...)", "put_global(rep...)"
+        ]
+
+    def test_silent_on_hoisted_shape(self):
+        # the fix shape (als_fit / als_fit_streamed): invariants put ONCE
+        # before the loop; only per-iteration batches transfer inside
+        assert run_rule(RuleJ006, """
+            import numpy as np
+            import jax
+
+            def train(batches, item_factors, users, step):
+                table = jax.device_put(np.asarray(item_factors))
+                for batch in batches:
+                    b = jax.device_put(batch)
+                    step(b, table)
+        """) == []
+
+    def test_silent_on_per_iteration_slices(self):
+        # the NCF/sequence trainer shape: the argument is sliced/rebound
+        # per iteration, so the transfer is per-batch by construction
+        assert run_rule(RuleJ006, """
+            def train(users, order, n, batch, step):
+                for start in range(0, n, batch):
+                    take = order[start : start + batch]
+                    step(put_global(users[take], None))
+        """) == []
+
+    def test_silent_outside_training_loops(self):
+        # a serving/IO loop with no step-shaped call is out of scope
+        assert run_rule(RuleJ006, """
+            import jax.numpy as jnp
+
+            def emit(rows, table, sink):
+                for r in rows:
+                    sink.write(jnp.asarray(table))
+        """) == []
+
+    def test_silent_on_container_update_calls(self):
+        # dict.update()/set.update() must not classify a loop as a
+        # training loop (the rule deliberately has no 'update' verb)
+        assert run_rule(RuleJ006, """
+            import jax.numpy as jnp
+
+            def collect(rows, table, seen, sink):
+                for r in rows:
+                    seen.update(r.ids)
+                    sink.write(jnp.asarray(table))
+        """) == []
+
+    def test_silent_inside_jitted_scope(self):
+        # under trace, asarray on an invariant is a no-op on tracers
+        assert run_rule(RuleJ006, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def fitted(xs, table):
+                out = 0.0
+                for x in xs:
+                    out = out + jnp.asarray(table) @ x
+                return out
         """) == []
 
 

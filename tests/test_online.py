@@ -285,6 +285,43 @@ class TestTailFixture:
         assert batch.lag_seconds() >= 0.0
         wal.close()
 
+    def test_set_records_tracked_not_counted(self, tmp_path):
+        """$set/$unset property records pass the event-name filter into
+        their own channel: a fold-in must learn the category aggregate
+        changed, but property events are not interactions -- they stay out
+        of records/touched_users and out of the snapshot-window clock."""
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.data.ingest import wal_payload
+        from predictionio_tpu.data.wal import WriteAheadLog
+        from predictionio_tpu.online.follower import WalTail
+
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        evs = [
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties=DataMap({"categories": ["a"]})),
+            Event(event="$unset", entity_type="user", entity_id="u1",
+                  properties=DataMap({"plan": None})),
+            Event(event="view", entity_type="user", entity_id="u2",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({})),
+        ]
+        for ev in evs:
+            last = wal.append(wal_payload(ev.with_id(), APP_ID, None))
+        wal.sync()
+        wal.checkpoint(last)
+        batch = WalTail(str(tmp_path / "wal"), APP_ID, None, ["view"]).poll(0)
+        assert batch.records == 1 and batch.touched_users == {"u2"}
+        assert batch.set_records == 2
+        assert batch.touched_set_types == {"item", "user"}
+        wal.close()
+        # a $set-ONLY window is NOT empty: the loop must run a cycle so
+        # property-derived indexes (e-commerce categories) can refresh
+        from predictionio_tpu.online.follower import TailBatch
+
+        only_set = TailBatch(set_records=1, touched_set_types={"item"})
+        assert not only_set.empty
+        assert only_set.lag_seconds() == 0.0
+
 
 # ---------------------------------------------------------------------------
 # registry
@@ -597,6 +634,127 @@ class TestAlgorithmFoldIn:
         )
         with pytest.raises(StalenessExceeded):
             algo.fold_in(model, delta)
+
+
+class TestECommerceCategoryRefresh:
+    """The fold-in path must rescan the ``$set`` category aggregate when
+    the window's touched events include item property records -- before
+    this, a category change served stale until the next full retrain."""
+
+    def _ecomm_model(self):
+        from predictionio_tpu.models.ecommerce.engine import ECommerceModel
+        from predictionio_tpu.parallel.als import (
+            ALSConfig, als_fit, build_als_data,
+        )
+
+        rng = np.random.default_rng(1)
+        U, I, E = 8, 5, 60
+        users = rng.integers(0, U, E)
+        items = rng.integers(0, I, E)
+        cfg = ALSConfig(rank=4, iterations=2, implicit=True, solver="xla")
+        als = als_fit(
+            build_als_data(users, items, np.ones(E, np.float32), U, I, cfg),
+            cfg,
+        )
+        uid = [f"u{k}" for k in range(U)]
+        iid = [f"i{k}" for k in range(I)]
+        return ECommerceModel(
+            als=als,
+            app_name="Shop",
+            user_index={u: k for k, u in enumerate(uid)},
+            item_ids=iid,
+            item_index={i: k for k, i in enumerate(iid)},
+            seen={},
+            category_items={"old": np.asarray([0], np.int64)},
+            similar_events=["view"],
+            seen_mode="model",
+        ), uid, iid
+
+    def _algo(self):
+        from predictionio_tpu.controller.base import Params
+        from predictionio_tpu.models.ecommerce.engine import ECommAlgorithm
+
+        return ECommAlgorithm(Params({"rank": 4, "numIterations": 2}))
+
+    def _empty_delta(self, uid, iid, set_types):
+        from predictionio_tpu.online.foldin import FoldinDelta
+
+        window_ms = int(time.time() * 1000)
+        snap = _FakeSnapshot([], [], [], [], [], list(uid), list(iid), [])
+        snap.manifest = {"until_ms": window_ms}
+        return FoldinDelta(
+            snap, window_ms, set_entity_types=set_types or None
+        )
+
+    def test_set_only_window_refreshes_categories(self, monkeypatch):
+        from predictionio_tpu.models.ecommerce import engine as ecomm
+
+        model, uid, iid = self._ecomm_model()
+        monkeypatch.setattr(
+            ecomm, "_load_categories",
+            lambda app, channel_name=None: {"i1": ["fresh"], "i3": ["fresh"]},
+        )
+        out = self._algo().fold_in(
+            model, self._empty_delta(uid, iid, {"item"})
+        )
+        # a $set-only window still publishes: same factor core, new index
+        assert out is not None
+        assert out.als is model.als
+        assert set(out.category_items) == {"fresh"}
+        np.testing.assert_array_equal(
+            out.category_items["fresh"], np.asarray([1, 3], np.int64)
+        )
+        # the served (old) model object is untouched
+        assert set(model.category_items) == {"old"}
+
+    def test_non_item_set_records_do_not_rescan(self, monkeypatch):
+        from predictionio_tpu.models.ecommerce import engine as ecomm
+
+        model, uid, iid = self._ecomm_model()
+
+        def boom(app, channel_name=None):
+            raise AssertionError("category aggregate must not be rescanned")
+
+        monkeypatch.setattr(ecomm, "_load_categories", boom)
+        # $set on users (or an empty window with no $set at all) -> the
+        # old behavior: nothing to fold, nothing published
+        assert self._algo().fold_in(
+            model, self._empty_delta(uid, iid, {"user"})
+        ) is None
+        assert self._algo().fold_in(
+            model, self._empty_delta(uid, iid, None)
+        ) is None
+
+    def test_interactions_and_set_fold_together(self, monkeypatch):
+        """A window carrying both a new-item interaction AND an item $set:
+        the rescanned index must be built against the EXTENDED item
+        vocabulary, so the brand-new item is filterable immediately."""
+        from predictionio_tpu.models.ecommerce import engine as ecomm
+        from predictionio_tpu.online.foldin import FoldinDelta, StalenessBudget
+
+        model, uid, iid = self._ecomm_model()
+        window_ms = int(time.time() * 1000)
+        t0 = window_ms / 1000.0
+        snap = _FakeSnapshot(
+            [0, 0], [len(iid), 1], [0, 0], [t0 + 1, t0 + 2], [np.nan, np.nan],
+            list(uid), list(iid) + ["inew"], ["view"],
+        )
+        monkeypatch.setattr(
+            ecomm, "_load_categories",
+            lambda app, channel_name=None: {"inew": ["fresh"], "i1": ["fresh"]},
+        )
+        delta = FoldinDelta(
+            snap, window_ms,
+            budget=StalenessBudget(1.0, 1.0, 1.0),
+            set_entity_types={"item"},
+        )
+        out = self._algo().fold_in(model, delta)
+        assert out is not None and out.als is not model.als
+        new_idx = out.item_index["inew"]
+        np.testing.assert_array_equal(
+            out.category_items["fresh"],
+            np.asarray(sorted([1, new_idx]), np.int64),
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -388,6 +388,65 @@ class TestRefreshTrainIdentity:
         assert cold_i.ids == rep_i.ids
 
 
+class TestSnapshotStreamedBlocks:
+    def test_streamed_epoch_from_snapshot_memmaps(self, app, tmp_path):
+        """The device-resident-epochs feed: a snapshot generation packs
+        into a block store under ITS OWN directory (GC'd with it) and the
+        streamed fit over it equals the resident fit over the live scan
+        bit-for-bit at equal shapes."""
+        from predictionio_tpu.data.snapshot import snapshot_block_dir
+        from predictionio_tpu.parallel.als import (
+            ALSConfig,
+            als_fit,
+            als_fit_streamed,
+            build_als_data,
+        )
+        from predictionio_tpu.parallel.mesh import local_mesh
+        from predictionio_tpu.parallel.reader import (
+            snapshot_streamed_als_data,
+            store_coo_chunks,
+        )
+
+        app_id, le = app
+        t1 = _insert(le, app_id, 300, n_users=40, n_items=16)
+        store = SnapshotStore(str(tmp_path), _spec(app_id))
+        snap = store.build(le, t1, chunk_rows=96)
+
+        cfg = ALSConfig(rank=4, iterations=2, buckets=2, max_len=32)
+        src, enc_u, enc_i = store_coo_chunks(
+            le, app_id, event_names=["rate", "buy"], chunk_rows=96,
+            until_time=t1,
+        )
+        uu, ii, vv, tt = [], [], [], []
+        for cu, ci, cv, ct in src():
+            uu.append(cu), ii.append(ci), vv.append(cv), tt.append(ct)
+        uu, ii = np.concatenate(uu), np.concatenate(ii)
+        vv, tt = np.concatenate(vv), np.concatenate(tt)
+        data = build_als_data(
+            uu, ii, vv, len(enc_u.ids), len(enc_i.ids), cfg, times=tt
+        )
+        mesh = local_mesh(1, 1)
+        resident = als_fit(data, cfg, mesh)
+
+        s_u, s_i, streamed_data = snapshot_streamed_als_data(
+            snap, cfg, chunk_rows=96, block_rows=1 << 20
+        )
+        assert s_u.ids == enc_u.ids and s_i.ids == enc_i.ids
+        assert streamed_data.directory.startswith(snapshot_block_dir(snap))
+        streamed = als_fit_streamed(streamed_data, cfg, mesh)
+        np.testing.assert_array_equal(
+            resident.user_factors, streamed.user_factors
+        )
+        np.testing.assert_array_equal(
+            resident.item_factors, streamed.item_factors
+        )
+        # second call reuses the committed store (same directory)
+        _, _, again = snapshot_streamed_als_data(
+            snap, cfg, chunk_rows=96, block_rows=1 << 20
+        )
+        assert again.directory == streamed_data.directory
+
+
 class TestDatasetFastPath:
     def test_dataset_served_from_snapshot(self, app, tmp_path):
         from predictionio_tpu.data.store import PEventStore
