@@ -626,6 +626,14 @@ def secondary_main(result_path: str) -> None:
         micro-batched scorer, identical raw-socket load at 32 clients
         (the stock http.client generator saturates near ~600 qps on this
         box -- below the process tier -- so it would measure itself).
+        Since PR 12 this is ALSO the scorer dispatch-model A/B: the
+        2-worker tier runs once with the sync dispatcher pool and once
+        with the async fast path (ring consumer -> micro-batcher future
+        -> flusher callback), both CPU-pinned via the --pin-cpus plan,
+        with the measured wakeups/request + dispatch-thread gauges
+        recorded per arm. PIO_BENCH_DISPATCH=sync|async narrows to one
+        arm (e.g. for a quick round); default 'both' captures the
+        comparison on any multi-core round without code changes.
         Includes the coalescing identity check: every arm's bodies come
         from the same scorer router. CPU-only like serving_qps."""
         if tpu:
@@ -635,14 +643,18 @@ def secondary_main(result_path: str) -> None:
             }
         from predictionio_tpu.tools.serving_bench import run_multiproc_ab
 
+        mode = os.environ.get("PIO_BENCH_DISPATCH", "both")
+        dispatch = ("sync", "async") if mode == "both" else mode
         rep = run_multiproc_ab(
             "recommendation",
             concurrency=32,
             requests=2000,
-            workers=(1, 2),
+            workers=(2,),
             users=300,
             items=30_000,
             events=60_000,
+            dispatch=dispatch,
+            pin_cpus=True,
         )
         out = {
             "qps_singleproc": rep["singleproc"]["qps"],
@@ -650,13 +662,24 @@ def secondary_main(result_path: str) -> None:
             "responses_equivalent": rep["responses_equivalent"],
             "qps_speedup": rep["qps_speedup"],
             "config": "#12 serving_qps_multiproc (32 raw clients, 30k"
-            " items, rank 64, workers 1/2)",
+            f" items, rank 64, 2 workers pinned, dispatch={mode})",
         }
-        for label in ("workers_1", "workers_2"):
-            if label in rep:
-                out[f"qps_{label}"] = rep[label]["qps"]
-                out[f"p50_ms_{label}"] = rep[label]["p50_ms"]
-                out[f"failures_{label}"] = rep[label]["failures"]
+        for label, arm in rep.items():
+            if not label.startswith("workers_"):
+                continue
+            out[f"qps_{label}"] = arm["qps"]
+            out[f"p50_ms_{label}"] = arm["p50_ms"]
+            out[f"failures_{label}"] = arm["failures"]
+            if arm.get("wakeups_per_request") is not None:
+                out[f"wakeups_per_request_{label}"] = (
+                    arm["wakeups_per_request"]
+                )
+                out[f"dispatch_threads_{label}"] = arm["dispatch_threads"]
+        for key in rep:
+            if key.startswith("qps_speedup_workers_") or key.startswith(
+                "qps_async_over_sync_workers_"
+            ):
+                out[key] = rep[key]
         return out
 
     def analysis_findings():

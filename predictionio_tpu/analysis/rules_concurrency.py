@@ -564,4 +564,118 @@ class RuleC004:
         return spawn_ctx, fork_ctx
 
 
-RULES = (RuleC001, RuleC002, RuleC003, RuleC004)
+class RuleC005:
+    """Blocking call inside a function passed to
+    ``Future.add_done_callback``. Incident class: the async scorer fast
+    path (PR 12) finishes every ``/queries.json`` request -- plugins,
+    serialization, the completion-ring push -- in a done-callback that
+    runs ON THE MICRO-BATCHER'S FLUSHER THREAD; one blocking call there
+    (fsync, SQL, socket I/O, ``time.sleep``, a timeout-less queue op --
+    the C002 catalog -- or another future's ``.result()``) stalls every
+    in-flight batch, not one request. The correct shape is the
+    completion-retry queue in ``serving/procserver.py``: try once
+    non-blocking, park overflow for a timer thread.
+
+    ``.result()`` on the callback's OWN argument (or a parameter the
+    future was forwarded to, one call level deep) is exempt: a done
+    callback receives an already-resolved future, so that call cannot
+    block. Propagates one level through intra-module calls, the C001
+    pattern."""
+
+    rule_id = "C005"
+    severity = "error"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        index = _lock_index(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_done_callback"
+                and node.args
+            ):
+                continue
+            caller_qual = ctx.symbol_for(node)
+            yield from self._check_callback(
+                ctx, index, caller_qual, node.args[0], node.lineno
+            )
+
+    def _check_callback(
+        self, ctx, index, caller_qual, cb: ast.AST, reg_line: int
+    ) -> Iterator[Finding]:
+        # functools.partial(fn, ...): the callable is the first arg
+        if isinstance(cb, ast.Call) and call_name(cb) in (
+            "partial", "functools.partial"
+        ) and cb.args:
+            cb = cb.args[0]
+        if isinstance(cb, ast.Lambda):
+            params = {a.arg for a in cb.args.args}
+            yield from self._scan(
+                ctx, index, caller_qual, cb, params, set()
+            )
+            return
+        name = dotted(cb)
+        if name is None:
+            return
+        facts = index.lookup(caller_qual, name)
+        if facts is None:
+            return
+        yield from self._scan(
+            ctx, index, facts.qual, facts.node,
+            self._params(facts.node), {facts.qual},
+        )
+
+    @staticmethod
+    def _params(fn: ast.AST) -> set[str]:
+        args = fn.args
+        names = {a.arg for a in args.args + args.kwonlyargs}
+        names.discard("self")
+        return names
+
+    def _scan(
+        self, ctx, index, qual: str, fn: ast.AST, params: set[str],
+        seen: set, depth: int = 0,
+    ) -> Iterator[Finding]:
+        """Walk one callback body (skipping nested defs -- they run on
+        their own call stack) for blocking calls; recurse one level into
+        intra-module callees."""
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call):
+                reason = _blocking_reason(node)
+                if reason is None and isinstance(node.func, ast.Attribute):
+                    if node.func.attr == "result":
+                        recv = dotted(node.func.value) or ""
+                        if recv not in params:
+                            reason = "Future.result()"
+                if reason is not None:
+                    yield Finding(
+                        self.rule_id, self.severity, ctx.path, node.lineno,
+                        qual,
+                        f"blocking call ({reason}) inside a "
+                        "Future.add_done_callback callback: it runs on "
+                        "the resolving thread (the micro-batcher's "
+                        "flusher on the serving path) and stalls every "
+                        "batch behind it",
+                        "do the work non-blocking and park overflow on "
+                        "another thread (the completion-retry-queue "
+                        "shape in serving/procserver.py)",
+                    )
+                elif depth < 1:
+                    name = call_name(node)
+                    if name and (name.startswith("self.") or "." not in name):
+                        callee = index.lookup(qual, name)
+                        if callee is not None and callee.qual not in seen:
+                            yield from self._scan(
+                                ctx, index, callee.qual, callee.node,
+                                self._params(callee.node),
+                                seen | {callee.qual}, depth + 1,
+                            )
+            stack.extend(ast.iter_child_nodes(node))
+
+
+RULES = (RuleC001, RuleC002, RuleC003, RuleC004, RuleC005)

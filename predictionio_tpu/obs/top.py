@@ -153,6 +153,11 @@ def compute_stats(prev: dict, cur: dict) -> dict:
         stats["ingest_queue_depth"] = stats.get(
             "ingest_queue_depth", 0
         ) + int(sum(serving_depth.values()))
+    wpr = cm.get("pio_scorer_wakeups_per_request")
+    if wpr:
+        # the scorer's measured dispatch cost: cross-thread wakeups per
+        # query (async fast path <= 2, sync dispatcher chain ~4)
+        stats["wakeups_per_request"] = round(max(wpr.values()), 2)
     workers = cm.get("pio_frontend_workers")
     if workers:
         # the multi-process serving tier: configured frontend count plus
@@ -203,7 +208,7 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
         time.strftime("pio top — %H:%M:%S", time.localtime()),
         "",
         f"{'SERVICE':<32}{'QPS':>8}{'P50MS':>9}{'P99MS':>9}"
-        f"{'ERR%':>7}{'QUEUE':>7}{'BATCH':>7}{'WKR':>5}"
+        f"{'ERR%':>7}{'QUEUE':>7}{'BATCH':>7}{'WKR':>5}{'WAKE':>6}"
         f"{'MODEL':>7}{'SWAP':>8}{'LAG':>7}",
     ]
     for s in stats_list:
@@ -219,6 +224,7 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
             f"{_fmt(s.get('ingest_queue_depth')):>7}"
             f"{_fmt(s.get('batch_occupancy')):>7}"
             f"{_fmt(s.get('frontend_workers')):>5}"
+            f"{_fmt(s.get('wakeups_per_request')):>6}"
             f"{_fmt(s.get('model_version')):>7}"
             f"{_fmt(s.get('swap_age_s'), 's'):>8}"
             f"{_fmt(s.get('foldin_lag_s'), 's'):>7}"

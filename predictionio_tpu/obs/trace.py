@@ -169,6 +169,15 @@ class _NullSpan:
     def set_status(self, status: str) -> None:
         pass
 
+    def attach(self) -> "_NullSpan":
+        return self
+
+    def detach(self) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
 
 NULL_SPAN = _NullSpan()
 
@@ -203,17 +212,40 @@ class _SampledOutRoot:
     def set_status(self, status: str) -> None:
         pass
 
+    def attach(self) -> "_SampledOutRoot":
+        """Handle-style suppression (the async fast path carries this
+        sentinel across threads like a real Span): raise the calling
+        thread's suppress flag so nested ``span()`` calls stay no-ops
+        instead of opening fresh roots. Pair with ``detach()``."""
+        _tls.suppress = True
+        return self
+
+    def detach(self) -> None:
+        _tls.suppress = False
+
+    def finish(self) -> None:
+        pass
+
 
 SAMPLED_OUT_ROOT = _SampledOutRoot()
 
 
 class Span:
     """A live span: context manager that pushes itself on the thread's
-    context stack and reports to its tracer on exit."""
+    context stack and reports to its tracer on exit.
+
+    Spans are also EXPLICIT HANDLES for code whose request does not stay
+    on one thread (the async scorer fast path): ``attach()``/``detach()``
+    manage the calling thread's context stack without ending the span,
+    and ``finish()`` records the end from ANY thread -- start a root on
+    the ring consumer, attach around the micro-batcher submit so
+    ``current_context()`` captures it, detach, and finish from the
+    flusher's ``Future.add_done_callback``. ``__enter__``/``__exit__``
+    are exactly ``attach()`` + (``detach()``; ``finish()``)."""
 
     __slots__ = (
         "_tracer", "op", "trace_id", "span_id", "parent_id", "attrs",
-        "status", "_start_pc", "_root",
+        "status", "_start_pc", "_root", "_finished",
     )
 
     def __init__(self, tracer: "Tracer", op: str, trace_id: str,
@@ -226,6 +258,7 @@ class Span:
         self.attrs = attrs
         self.status = "ok"
         self._root = root
+        self._finished = False
         if root:
             # register the trace as live IMMEDIATELY: record_span from
             # another thread can attach to it for the root's whole lifetime
@@ -244,21 +277,30 @@ class Span:
     def set_status(self, status: str) -> None:
         self.status = status
 
-    def __enter__(self) -> "Span":
+    def attach(self) -> "Span":
+        """Push this span onto the CALLING thread's context stack (so
+        ``current_context()`` and nested ``tracer.span()`` calls see it)
+        without affecting its lifetime. Pair with ``detach()``."""
         _stack().append(self)
         return self
 
-    def __exit__(self, exc_type, exc, tb) -> bool:
+    def detach(self) -> None:
+        """Pop this span off the calling thread's context stack WITHOUT
+        finishing it -- the span stays live and can be finished later
+        from another thread."""
         stack = _stack()
         if stack and stack[-1] is self:
             stack.pop()
         elif self in stack:  # defensive: mis-nested exits must not corrupt
             stack.remove(self)
-        if exc_type is not None:
-            self.status = "error"
-            if self.attrs is None:
-                self.attrs = {}
-            self.attrs.setdefault("error", f"{exc_type.__name__}: {exc}")
+
+    def finish(self) -> None:
+        """Record the span's end. Thread-agnostic and idempotent (a
+        double finish records once); does NOT touch any context stack --
+        callers that attached must detach themselves."""
+        if self._finished:
+            return
+        self._finished = True
         end_pc = time.perf_counter()
         record = SpanRecord(
             trace_id=self.trace_id,
@@ -272,6 +314,18 @@ class Span:
             thread=threading.current_thread().name,
         )
         self._tracer._span_finished(record, self._root)
+
+    def __enter__(self) -> "Span":
+        return self.attach()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.detach()
+        if exc_type is not None:
+            self.status = "error"
+            if self.attrs is None:
+                self.attrs = {}
+            self.attrs.setdefault("error", f"{exc_type.__name__}: {exc}")
+        self.finish()
         return False
 
 

@@ -42,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import selectors
 import socket
 import time
@@ -496,8 +497,21 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--wake-stop", required=True)
     ap.add_argument("--server-name", default="pio-queryserver")
     ap.add_argument("--stats-flush-s", type=float, default=0.25)
+    ap.add_argument(
+        "--pin-cpu", type=int, default=-1, metavar="CORE",
+        help="sched_setaffinity this worker to one core (-1 = unpinned);"
+        " set by the scorer bridge under pio deploy --pin-cpus",
+    )
     args = ap.parse_args(argv)
 
+    if args.pin_cpu >= 0 and hasattr(os, "sched_setaffinity"):
+        try:
+            os.sched_setaffinity(0, {args.pin_cpu})
+        except OSError:
+            logger.warning(
+                "could not pin frontend worker %d to cpu %d",
+                args.worker, args.pin_cpu,
+            )
     ring = shmring.RingFile.attach(args.ring)
     listener = reuseport_listener(args.host, args.port)
     worker = FrontendWorker(

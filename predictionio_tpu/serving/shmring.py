@@ -90,6 +90,14 @@ class Wakeup:
         self._fifo_path = fifo_path
         self._fifo_rfd: int | None = None
         self._fifo_wfd: int | None = None
+        #: cumulative wake accounting, per PROCESS-LOCAL object (the fd is
+        #: shared across the spawn; these counters are not): ``signals`` =
+        #: signal() calls issued from this side, ``wakes`` = drain() calls
+        #: (each one a real "this side was woken / serviced the fd"
+        #: event). The scorer aggregates them into the wakeup-budget
+        #: gauges (``pio_scorer_wakeups_per_request``).
+        self.signals = 0
+        self.wakes = 0
 
     @classmethod
     def create(cls, fifo_dir: str, name: str) -> "Wakeup":
@@ -130,6 +138,7 @@ class Wakeup:
         return self._fifo_rfd
 
     def signal(self) -> None:
+        self.signals += 1
         try:
             if self._fd is not None:
                 os.write(self._fd, struct.pack("<Q", 1))
@@ -159,6 +168,7 @@ class Wakeup:
             return False
 
     def drain(self) -> None:
+        self.wakes += 1
         try:
             fd = self._read_fd()
             while True:

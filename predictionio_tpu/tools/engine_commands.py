@@ -117,9 +117,26 @@ def register(sub: argparse._SubParsersAction) -> None:
     )
     deploy.add_argument(
         "--frontend-max-inflight", type=int, default=16, metavar="N",
-        help="concurrent dispatches the scorer admits (= dispatcher "
-        "threads and the micro-batcher's coalescing ceiling) before "
-        "letting the rings back up (the backpressure horizon)",
+        help="concurrent requests the scorer admits before letting the "
+        "rings back up (the backpressure horizon and the micro-batcher's "
+        "coalescing ceiling; with --dispatch sync, also the dispatcher "
+        "thread count)",
+    )
+    deploy.add_argument(
+        "--dispatch", choices=("async", "sync"), default="async",
+        help="scorer dispatch model with --frontend-workers: 'async' "
+        "(ring consumer submits straight into the micro-batcher; zero "
+        "dispatcher threads and 2 wakeups on the query path) or 'sync' "
+        "(dispatcher thread pool -- the pre-async model, kept for A/B; "
+        "also used whenever batching is disabled)",
+    )
+    deploy.add_argument(
+        "--pin-cpus", action=argparse.BooleanOptionalAction,
+        default=os.environ.get("PIO_PIN_CPUS", "") not in ("", "0"),
+        help="sched_setaffinity: pin each frontend worker to one core "
+        "from the top of the affinity set, the scorer keeps the rest "
+        "(default from PIO_PIN_CPUS=1; --no-pin-cpus overrides it); "
+        "needs --frontend-workers and >=2 cores",
     )
     deploy.add_argument(
         "--no-tracing", action="store_true",
@@ -321,6 +338,8 @@ def cmd_deploy(args: argparse.Namespace) -> int:
             workers=args.frontend_workers,
             ring_slots=args.frontend_ring_slots,
             max_inflight=args.frontend_max_inflight,
+            dispatch=args.dispatch,
+            pin_cpus=args.pin_cpus,
         )
     from predictionio_tpu.online.registry import RegistryError
 

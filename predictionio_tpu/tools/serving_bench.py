@@ -355,14 +355,24 @@ def _synthetic_deployment(engine: str, users, items, events):
 def _load_in_subprocess(
     url: str, concurrency: int, n_requests: int, query: dict,
     client: str = "http",
+    affinity: "set | None" = None,
 ) -> dict:
     """Drive ``run_load`` from a child interpreter: a co-resident client
     pool would fight the server threads for the GIL and understate every
-    arm."""
+    arm. ``affinity`` (the PRE-pin cpu mask, captured before any
+    ``--pin-cpus`` arm narrowed this process) is re-applied in the
+    child: without it the generator inherits the pinned scorer's
+    shrunken mask and the bench measures the generator, not the
+    server -- worst at high worker counts, inverting the sweep."""
     import os
     import subprocess
     import sys
 
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    if affinity is not None:
+        # applied by the child's main() after exec -- a preexec_fn would
+        # force a bare fork() inside this (JAX-)threaded process
+        env["PIO_BENCH_AFFINITY"] = ",".join(str(c) for c in sorted(affinity))
     proc = subprocess.run(
         [
             sys.executable, "-m",
@@ -374,7 +384,7 @@ def _load_in_subprocess(
             "--client", client,
         ],
         capture_output=True, text=True, timeout=600,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        env=env,
     )
     if proc.returncode != 0:
         raise RuntimeError(
@@ -467,14 +477,25 @@ def _measure_arms(
     compilation must not land in the measured window) plus a coalescing
     identity probe.
     """
+    import os as _os
+
     from predictionio_tpu.workflow.create_server import (
         create_multiproc_query_server,
         create_query_server,
     )
 
+    # captured BEFORE any pinned arm narrows this process's mask: the
+    # load-generator children are re-widened to it (see
+    # _load_in_subprocess)
+    baseline_affinity = (
+        _os.sched_getaffinity(0)
+        if hasattr(_os, "sched_getaffinity") else None
+    )
+
     def load_in_subprocess(url: str, n_requests: int) -> dict:
         return _load_in_subprocess(
-            url, concurrency, n_requests, query, client=client
+            url, concurrency, n_requests, query, client=client,
+            affinity=baseline_affinity,
         )
 
     def concurrent_bodies(url: str) -> list[bytes]:
@@ -506,10 +527,46 @@ def _measure_arms(
             sequential[label] = _sequential_bodies(url, users)
             responses[label] = concurrent_bodies(url)
             reports[label] = load_in_subprocess(url, requests)
+            if service.scorer_stats is not None:
+                # the measured wakeup budget: the async arm must show
+                # <=2 wakeups/request and zero query-path dispatcher
+                # threads. Read from the served /metrics gauges -- the
+                # bench records the exact number operators see, with ONE
+                # definition of the formula (the service's mirror hook)
+                gauges = _scorer_gauges(url)
+                reports[label]["wakeups_per_request"] = gauges.get(
+                    "pio_scorer_wakeups_per_request"
+                )
+                threads = gauges.get("pio_scorer_dispatch_threads")
+                reports[label]["dispatch_threads"] = (
+                    int(threads) if threads is not None else None
+                )
         finally:
             handle.stop()
             service.close()
     return reports, responses, sequential
+
+
+def _scorer_gauges(url: str) -> dict[str, float]:
+    """The scorer's wakeup-budget gauges from its live /metrics."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except Exception:
+        return {}
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        for name in (
+            "pio_scorer_wakeups_per_request", "pio_scorer_dispatch_threads"
+        ):
+            if line.startswith(name + " "):
+                try:
+                    out[name] = float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    pass
+    return out
 
 
 def run_ab(
@@ -611,6 +668,8 @@ def run_multiproc_ab(
     window_ms: float = 2.0,
     max_batch_size: int = 64,
     max_inflight: int | None = None,
+    dispatch: "str | tuple" = "async",
+    pin_cpus: bool = False,
 ) -> dict:
     """The multi-process serving A/B: the single-process
     ``ThreadingHTTPServer`` tier vs N ``SO_REUSEPORT`` frontend workers
@@ -621,20 +680,35 @@ def run_multiproc_ab(
     Reports per-arm ``run_load`` stats, per-worker-count speedups, and
     the coalescing identity probe (bodies must be byte-identical across
     every arm: all of them are produced by the same scorer router).
+
+    ``dispatch`` picks the scorer dispatch model per process-tier arm:
+    ``"async"`` (ring consumer -> micro-batcher future -> flusher
+    callback; zero dispatcher threads), ``"sync"`` (the dispatcher-pool
+    tier), or a tuple of both for the sync-vs-async A/B -- arms are then
+    labeled ``workers_N_sync`` / ``workers_N_async`` and the report adds
+    ``qps_async_over_sync_workers_N``. ``pin_cpus`` turns on the
+    ``sched_setaffinity`` plan (frontends one core each off the top,
+    scorer keeps the rest) for every process-tier arm; combine with a
+    ``workers`` sweep like ``(1, 2, 4, 8)`` on real multi-core hardware.
     """
     from predictionio_tpu.workflow.microbatch import BatchConfig
 
     from predictionio_tpu.serving.procserver import FrontendConfig
 
+    modes = (dispatch,) if isinstance(dispatch, str) else tuple(dispatch)
     batching = BatchConfig(window_ms=window_ms, max_batch_size=max_batch_size)
     arms: dict[str, dict] = {"singleproc": {"batching": batching}}
     for n in sorted(set(int(w) for w in workers if int(w) > 0)):
-        fe = FrontendConfig(workers=n)
-        if max_inflight is not None:
-            fe.max_inflight = max_inflight
-        arms[f"workers_{n}"] = {
-            "batching": batching, "frontend_workers": fe,
-        }
+        for mode in modes:
+            fe = FrontendConfig(workers=n, dispatch=mode, pin_cpus=pin_cpus)
+            if max_inflight is not None:
+                fe.max_inflight = max_inflight
+            label = (
+                f"workers_{n}" if len(modes) == 1 else f"workers_{n}_{mode}"
+            )
+            arms[label] = {
+                "batching": batching, "frontend_workers": fe,
+            }
     prev_blas = _set_blas_threads(1)
     try:
         with _synthetic_deployment(engine, users, items, events) as (variant, sizes):
@@ -680,11 +754,22 @@ def run_multiproc_ab(
         if label == "singleproc" or not sp:
             continue
         out[f"qps_speedup_{label}"] = round(reports[label]["qps"] / sp, 2)
+    if len(modes) > 1:
+        # the dispatch-model A/B: async over sync at identical worker count
+        for n in sorted(set(int(w) for w in workers if int(w) > 0)):
+            sync_qps = reports.get(f"workers_{n}_sync", {}).get("qps")
+            async_qps = reports.get(f"workers_{n}_async", {}).get("qps")
+            if sync_qps and async_qps:
+                out[f"qps_async_over_sync_workers_{n}"] = round(
+                    async_qps / sync_qps, 2
+                )
     best = max(
         (reports[label]["qps"] for label in arms if label != "singleproc"),
         default=0.0,
     )
     out["qps_speedup"] = round(best / sp, 2) if sp else None
+    out["dispatch"] = list(modes)
+    out["pin_cpus"] = pin_cpus
     return out
 
 
@@ -808,6 +893,17 @@ def run_trace_ab(
 
 
 def main(argv: list[str] | None = None) -> int:
+    import os
+
+    mask = os.environ.get("PIO_BENCH_AFFINITY")
+    if mask and hasattr(os, "sched_setaffinity"):
+        # the load-generator child of a --pin-cpus A/B: re-widen to the
+        # pre-pin mask the parent recorded, so the generator never
+        # measures itself time-slicing the pinned scorer's cores
+        try:
+            os.sched_setaffinity(0, {int(c) for c in mask.split(",")})
+        except (OSError, ValueError):
+            pass
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--url", default=None,
@@ -844,9 +940,21 @@ def main(argv: list[str] | None = None) -> int:
         " generator)",
     )
     ap.add_argument(
-        "--frontend-workers", type=int, default=None, metavar="N",
+        "--frontend-workers", default=None, metavar="N[,N...]",
         help="run the multi-process serving sweep instead: single-process"
-        " vs SO_REUSEPORT frontend tiers of 1, 2 and N workers",
+        " vs SO_REUSEPORT frontend tiers; a single N sweeps 1, 2 and N"
+        " workers, a comma list (e.g. '1,2,4,8') sweeps exactly those",
+    )
+    ap.add_argument(
+        "--dispatch", choices=("async", "sync", "both"), default="async",
+        help="scorer dispatch model for the multi-process sweep arms:"
+        " async fast path (default), the sync dispatcher pool, or both"
+        " (the sync-vs-async A/B; labels arms workers_N_sync/_async)",
+    )
+    ap.add_argument(
+        "--pin-cpus", action="store_true",
+        help="pin frontend workers and scorer to disjoint cores"
+        " (sched_setaffinity) in every multi-process sweep arm",
     )
     args = ap.parse_args(argv)
     if args.url:
@@ -863,17 +971,34 @@ def main(argv: list[str] | None = None) -> int:
         engines = (
             ["recommendation"] if args.engine == "both" else [args.engine]
         )
+        try:
+            sweep = tuple(
+                int(w) for w in str(args.frontend_workers).split(",")
+                if w.strip()
+            )
+        except ValueError:
+            ap.error(
+                f"--frontend-workers must be an int or comma list, got "
+                f"{args.frontend_workers!r}"
+            )
+        if len(sweep) == 1:
+            sweep = (1, 2) + sweep
+        dispatch = (
+            ("sync", "async") if args.dispatch == "both" else args.dispatch
+        )
         report = {
             name: run_multiproc_ab(
                 name,
                 concurrency=args.clients or 32,
                 requests=args.requests or 2000,
-                workers=(1, 2, args.frontend_workers),
+                workers=sweep,
                 users=args.users,
                 items=args.items,
                 events=args.events,
                 window_ms=args.batch_window_ms,
                 max_batch_size=args.max_batch_size,
+                dispatch=dispatch,
+                pin_cpus=args.pin_cpus,
             )
             for name in engines
         }

@@ -201,6 +201,17 @@ class Router:
         self._record(request, route_label, response.status, t0)
         return response
 
+    def record_route(
+        self, request: Request, route: str, status: int, t0: float
+    ) -> None:
+        """Record the per-route request metrics for a request answered
+        OUTSIDE ``dispatch`` -- the async scorer fast path submits
+        ``/queries.json`` straight into the micro-batcher and finishes in
+        a future callback, but its requests must land in the same
+        ``pio_http_requests_total``/duration series with the same bounded
+        route label."""
+        self._record(request, route, status, t0)
+
     def _record(self, request: Request, route: str, status: int, t0: float) -> None:
         if self.metrics is None:
             return

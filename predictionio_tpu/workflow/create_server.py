@@ -41,6 +41,11 @@ from dataclasses import dataclass
 from typing import Any
 
 from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.obs.trace import (
+    NULL_SPAN,
+    SAMPLED_OUT_ROOT,
+    format_traceparent,
+)
 from predictionio_tpu.utils.http import (
     Request,
     Response,
@@ -116,6 +121,33 @@ class QueryService:
         #: set by the multi-process tier: {"workers": N, ...} for the info
         #: page (``pio top``/operators see the process model at a glance)
         self.frontend_info: dict | None = None
+        #: set by the multi-process tier: the scorer bridge's
+        #: ``wakeup_stats`` callable; the /metrics mirror turns it into
+        #: the wakeup-budget gauges (``pio_scorer_wakeups_per_request``,
+        #: ``pio_scorer_dispatch_threads``)
+        self.scorer_stats = None
+        #: measured future-park wakeups: sync ring dispatches that had to
+        #: block a dispatcher thread on the batcher future (the async
+        #: fast path never parks). Plain int: += is GIL-atomic enough for
+        #: a telemetry counter
+        self._future_parks = 0
+        #: async fast-path timeout backstop: same budget as the sync
+        #: path's bounded future wait (window + execution allowance); a
+        #: wedged batch answers 503 instead of holding admission permits
+        #: forever. Enforced by a lazy 1 Hz watchdog thread.
+        self._async_timeout_s = (
+            self.batching.window_ms / 1000.0 + 30.0
+            if self.batching.enabled else 30.0
+        )
+        self._async_lock = threading.Lock()
+        #: in-flight async queries: dicts with future/request/span/t0/
+        #: on_done/deadline/claimed; ``claimed`` is the exactly-once gate
+        #: between the future callback and the watchdog's 503. Entries
+        #: leave the list at claim time, so it only ever holds truly
+        #: in-flight requests (bounded by the bridge's admission limit).
+        self._async_pending: list = []
+        self._async_watchdog: threading.Thread | None = None
+        self._async_stop = False
         self._lock = threading.RLock()
         #: serializes whole swap operations (rehydrate + bind): without it
         #: two concurrent swaps bind in COMPLETION order, so a slow
@@ -165,6 +197,47 @@ class QueryService:
                     help="Seconds of ingested events not yet reflected in"
                     " the serving model (pushed by pio retrain --follow)",
                 )
+            stats_fn = self.scorer_stats
+            if stats_fn is not None:
+                try:
+                    s = stats_fn()
+                except Exception:
+                    s = None
+                if s:
+                    total = (
+                        s["wake_events"] + s["handoffs"]
+                        + s["completion_signals"] + self._future_parks
+                    )
+                    n = s["query_requests"]
+                    registry.set_counter(
+                        "pio_scorer_wakeups_total", float(total),
+                        help="Cross-thread wakeups on the scorer's query"
+                        " path (consumer eventfd wakes + dispatcher"
+                        " handoffs + future parks + completion signals)",
+                    )
+                    registry.set_counter(
+                        "pio_scorer_query_requests_total", float(n),
+                        help="Query frames popped from the frontend rings",
+                    )
+                    registry.set_gauge(
+                        "pio_scorer_wakeups_per_request",
+                        round(total / n, 3) if n else 0.0,
+                        help="Measured query-path wakeups per request"
+                        " (sync dispatch ~4, async fast path <= 2)",
+                    )
+                    registry.set_gauge(
+                        "pio_scorer_dispatch_threads",
+                        float(s["dispatch_threads"]),
+                        help="Dispatcher threads serving the query path"
+                        " (0 = async fast path; control routes keep a"
+                        " separate small pool)",
+                    )
+                    registry.set_gauge(
+                        "pio_scorer_completion_retry_depth",
+                        float(s["retry_depth"]),
+                        help="Completions parked on the ring-full timer"
+                        " retry queue",
+                    )
 
         self.router, self.metrics = instrumented_router(
             before_scrape=mirror, tracing=tracing,
@@ -463,7 +536,13 @@ class QueryService:
                 # top covers execution (first-bucket jit compiles included)
                 wait_s = self.batching.window_ms / 1000.0 + 30.0
                 try:
-                    result, version = self._batcher.submit(query_obj).result(wait_s)
+                    fut = self._batcher.submit(query_obj)
+                    if request.frontend_pc is not None and not fut.done():
+                        # a ring-dispatched request about to park a
+                        # dispatcher thread on the future: one measured
+                        # wakeup the async fast path does not pay
+                        self._future_parks += 1
+                    result, version = fut.result(wait_s)
                 except BatcherStopped:
                     return Response(503, {"message": "server is stopping"})
                 except _FutureTimeout:
@@ -479,6 +558,18 @@ class QueryService:
             return Response(exc.status, {"message": str(exc)})
         except (KeyError, TypeError, ValueError) as exc:
             return Response(400, {"message": f"bad query: {exc}"})
+        return self._respond(query_obj, result, version)
+
+    def _respond(self, query_obj, result, version) -> Response:
+        """The shared post-predict completion tail -- sniffer plugins,
+        serialization, feedback, served count, version header -- used by
+        BOTH the sync request-thread path (``handle_query``) and the
+        async flusher-callback path (``_finish_async_query``), so the
+        tier's byte-identity contract cannot drift between them. Callers
+        must have the request's trace context active on the calling
+        thread (a request-thread dispatch span, or the async path's
+        attached handle) so ``query.respond`` lands in the right trace."""
+        tracer = self.router.tracer
         for plugin in self.plugins:
             plugin.output_sniffer(query_obj, result)
         with self._lock:
@@ -508,6 +599,241 @@ class QueryService:
             # once the registry/swap subsystem is in play.
             response.headers["x-pio-model-version"] = str(version)
         return response
+
+    # -- async fast path (multi-process tier, dispatcherless dispatch) ------
+    #: the fast path bypasses Router.dispatch, so it pins the route label
+    #: its metrics/spans use to the registered pattern
+    _QUERY_ROUTE = "/queries.json"
+
+    def submit_query_async(self, request: Request, on_done) -> None:
+        """The dispatcher-less fast path of the multi-process tier: the
+        scorer bridge's ring consumer calls this for ``POST
+        /queries.json`` frames instead of routing them through the
+        dispatcher pool. Parse + micro-batcher submit happen on the
+        CALLING (consumer) thread; everything after the model answers --
+        plugin hooks, serialization, feedback, route metrics, the trace
+        root -- runs in a ``Future.add_done_callback`` on the batcher's
+        flusher thread. ``on_done(response)`` is called exactly once
+        (synchronously for immediate errors) and must never block: the
+        bridge's continuation does one non-blocking ring push and parks
+        overflow on a timer-driven retry queue (``pio check`` C005 is
+        the static gate for this contract).
+
+        Trace spans are explicit handles here: the root starts on the
+        consumer, is attached around ``submit`` so the batcher captures
+        the context, and finishes in the callback -- the
+        ``frontend.ring_wait``/``query.parse``/shared batch spans land in
+        the same trace shape as the sync path. Every response is built by
+        the same code as :meth:`handle_query`, so bodies stay
+        byte-identical across dispatch modes."""
+        t0 = _time.perf_counter()
+        tracer = self.router.tracer
+        span = None
+        guard = NULL_SPAN
+        if tracer.enabled:
+            traceparent = next(
+                (
+                    v for k, v in request.headers.items()
+                    if k.lower() == "traceparent"
+                ),
+                None,
+            )
+            root = tracer.start_remote(
+                f"POST {self._QUERY_ROUTE}", traceparent
+            )
+            if root.trace_id is not None:  # sampled-out roots record nothing
+                span = root
+                guard = root
+            else:
+                # suppress nested span() calls exactly as the sync
+                # path's sampled-out root does on its dispatch thread
+                guard = SAMPLED_OUT_ROOT
+        guard.attach()
+        try:
+            if span is not None and request.frontend_pc is not None:
+                recv_pc, dispatch_pc, worker = request.frontend_pc
+                tracer.record_span(
+                    span.trace_id, "frontend.ring_wait", recv_pc,
+                    dispatch_pc, parent_id=span.span_id,
+                    attrs={"worker": worker},
+                )
+            try:
+                with tracer.span("query.parse"):
+                    query_obj = request.json()
+            except json.JSONDecodeError:
+                self._finish_async_response(
+                    request,
+                    Response(400, {"message": "malformed JSON query"}),
+                    span, t0, on_done,
+                )
+                return
+            batcher = self._batcher
+            if batcher is None:
+                # the bridge only wires this path with batching enabled;
+                # answered (not raised) so a misconfiguration stays visible
+                self._finish_async_response(
+                    request,
+                    Response(
+                        503, {"message": "async dispatch requires batching"}
+                    ),
+                    span, t0, on_done,
+                )
+                return
+            try:
+                # submit captures current_context() from the attached guard
+                future = batcher.submit(query_obj)
+            except BatcherStopped:
+                self._finish_async_response(
+                    request, Response(503, {"message": "server is stopping"}),
+                    span, t0, on_done,
+                )
+                return
+            entry = {
+                "future": future,
+                "query_obj": query_obj,
+                "request": request,
+                "span": span,
+                "t0": t0,
+                "on_done": on_done,
+                "deadline": t0 + self._async_timeout_s,
+                "claimed": False,
+            }
+            with self._async_lock:
+                self._async_pending.append(entry)
+                if self._async_watchdog is None and not self._async_stop:
+                    self._async_watchdog = threading.Thread(
+                        target=self._async_watch,
+                        name="pio-async-watchdog", daemon=True,
+                    )
+                    self._async_watchdog.start()
+            future.add_done_callback(
+                lambda f: self._finish_async_query(entry, f)
+            )
+        except Exception:
+            # the Router._dispatch backstop contract (e.g. a non-UTF-8
+            # body raising UnicodeDecodeError in parse): the request
+            # still gets its 500, envelope, metrics, and span finish
+            logger.exception("async query submission failed")
+            self._finish_async_response(
+                request, Response(500, {"message": "internal server error"}),
+                span, t0, on_done,
+            )
+        finally:
+            guard.detach()
+
+    def _claim_async(self, entry: dict) -> bool:
+        """Exactly-once gate between the future callback and the
+        watchdog's timeout 503: first claimer finishes the request (and
+        removes the entry, so the pending list holds only live ones)."""
+        with self._async_lock:
+            if entry["claimed"]:
+                return False
+            entry["claimed"] = True
+            try:
+                self._async_pending.remove(entry)
+            except ValueError:
+                pass
+            return True
+
+    def _async_watch(self) -> None:
+        """1 Hz sweep over in-flight async queries: a future that blew
+        the sync path's wait budget answers 503 "batched predict timed
+        out" (releasing its admission permit through on_done) instead of
+        holding the permit until a wedged batch resolves -- the sync
+        dispatcher's ``result(wait_s)`` backstop, off-thread. Exits
+        within a tick of ``close()``."""
+        while not self._async_stop:
+            _time.sleep(1.0)
+            now = _time.perf_counter()
+            fire = []
+            with self._async_lock:
+                keep = []
+                for entry in self._async_pending:
+                    if entry["claimed"]:
+                        continue
+                    if now >= entry["deadline"] and not entry["future"].done():
+                        entry["claimed"] = True
+                        fire.append(entry)
+                    else:
+                        keep.append(entry)
+                self._async_pending = keep
+            for entry in fire:
+                self._finish_async_response(
+                    entry["request"],
+                    Response(503, {"message": "batched predict timed out"}),
+                    entry["span"], entry["t0"], entry["on_done"],
+                )
+
+    def _finish_async_query(self, entry: dict, future) -> None:
+        """The flusher-thread continuation: exactly ``handle_query``'s
+        post-predict semantics (plugin rejection -> status, bad query ->
+        400, anything unexpected -> the dispatch backstop's 500) via the
+        shared ``_respond`` tail, then the response envelope. ``future``
+        is this callback's own argument and is already resolved --
+        ``.result()`` here cannot block. No-op if the watchdog already
+        answered the request's timeout 503."""
+        if not self._claim_async(entry):
+            return
+        query_obj = entry["query_obj"]
+        span = entry["span"]
+        tracer = self.router.tracer
+        guard = span
+        if guard is None:
+            guard = SAMPLED_OUT_ROOT if tracer.enabled else NULL_SPAN
+        result = None
+        version = None
+        response = None
+        guard.attach()
+        try:
+            try:
+                result, version = future.result()
+                for plugin in self.plugins:
+                    plugin.output_blocker(query_obj, result)
+            except BatcherStopped:
+                response = Response(503, {"message": "server is stopping"})
+            except ServerRejection as exc:
+                response = Response(exc.status, {"message": str(exc)})
+            except (KeyError, TypeError, ValueError) as exc:
+                response = Response(400, {"message": f"bad query: {exc}"})
+            if response is None:
+                response = self._respond(query_obj, result, version)
+        except Exception:
+            # the Router._dispatch backstop contract, off-router
+            logger.exception("async query completion failed")
+            response = Response(500, {"message": "internal server error"})
+        finally:
+            guard.detach()
+        self._finish_async_response(
+            entry["request"], response, span, entry["t0"], entry["on_done"]
+        )
+
+    def _finish_async_response(
+        self, request: Request, response: Response, span, t0: float, on_done
+    ) -> None:
+        """Stamp the routing envelope Router.dispatch would have (trace
+        attrs, response ``traceparent``, error-body ``traceId``, route
+        metrics), finish the root span, hand off. Never raises."""
+        if span is not None:
+            span.set_attr("status", response.status)
+            if response.status >= 500:
+                span.set_status("error")
+            response.headers.setdefault(
+                "traceparent",
+                format_traceparent(span.trace_id, span.span_id),
+            )
+            if response.status >= 400 and isinstance(response.body, dict):
+                response.body.setdefault("traceId", span.trace_id)
+            span.finish()
+        try:
+            self.router.record_route(
+                request, self._QUERY_ROUTE, response.status, t0
+            )
+        except Exception:
+            logger.warning("route metrics recording failed", exc_info=True)
+        try:
+            on_done(response)
+        except Exception:
+            logger.exception("async completion delivery failed")
 
     def handle_model_swap(self, request: Request) -> Response:
         """``POST /models/swap {"version": N?}``: hot-swap a registry
@@ -592,9 +918,18 @@ class QueryService:
     def close(self) -> None:
         """Graceful drain: flush every in-flight batched query (their
         request threads are parked on futures and still get answers), then
-        stop the flusher. Call AFTER the HTTP listener stops accepting."""
+        stop the flusher. Call AFTER the HTTP listener stops accepting.
+        The async watchdog (if the multi-process fast path started one)
+        exits within a tick, so a closed service is fully collectable."""
         if self._batcher is not None:
             self._batcher.close()
+        self._async_stop = True
+        watchdog = self._async_watchdog
+        if watchdog is not None:
+            watchdog.join(timeout=2.0)
+            self._async_watchdog = None
+        with self._async_lock:
+            self._async_pending.clear()
 
     # -- feedback loop ------------------------------------------------------
     def _send_feedback(self, query: Any, prediction: Any, pr_id: str) -> None:
@@ -700,10 +1035,18 @@ def create_multiproc_query_server(
     service = QueryService(
         variant, extra_metrics_snapshots=worker_snapshots, **service_kwargs
     )
+    # the async fast path needs a future per query, i.e. the batcher; a
+    # batching-disabled deploy (or an explicit dispatch="sync") keeps the
+    # dispatcher-pool model
+    async_query = None
+    if frontend.dispatch == "async" and service._batcher is not None:
+        async_query = service.submit_query_async
     bridge = ScorerBridge(
-        service.router, host, port, frontend, registry=service.metrics
+        service.router, host, port, frontend, registry=service.metrics,
+        async_query=async_query,
     )
     bridge_cell.append(bridge)
+    service.scorer_stats = bridge.wakeup_stats
     service.frontend_info = frontend.describe()
     return MultiprocServiceHandle(bridge, service), service
 

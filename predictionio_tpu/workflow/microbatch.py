@@ -47,6 +47,17 @@ each coalesced request's trace: a per-request ``batch.queue_wait`` span
 (enqueue -> flush) plus batch-level ``batch.assemble`` and
 ``batch.execute`` spans whose span ids are SHARED across the batch -- the
 join key that answers "which requests rode the batch my request rode".
+
+**Done-callback contract (the async serving fast path).** ``submit``'s
+future supports ``add_done_callback``; the multi-process scorer uses it
+to serialize and push each response from the flusher thread with ZERO
+dispatcher threads on the query path. Callbacks fire synchronously
+inside ``_flush`` as each future resolves, ON THE FLUSHER THREAD: a
+callback that blocks (fsync, SQL, socket I/O, another future's
+``.result()``, a timeout-less queue op) stalls every in-flight and
+future batch, not one request. ``pio check`` C005 statically enforces
+this; overflow work (e.g. a full completion ring) must be parked on
+another thread, never waited for here.
 """
 
 from __future__ import annotations
@@ -320,6 +331,10 @@ class MicroBatcher:
                 batch, reason, pad, flush_pc, exec_pc, status="error"
             )
             return
+        # set_result/set_exception run any add_done_callback INLINE on
+        # this flusher thread (the async serving tier's completion push
+        # rides exactly this); callbacks must follow the module's
+        # no-blocking contract or they stall every batch behind them
         for p, result in zip(batch, results):  # padding tail dropped
             if isinstance(result, Exception):
                 p.future.set_exception(result)

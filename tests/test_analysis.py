@@ -24,6 +24,7 @@ from predictionio_tpu.analysis.rules_concurrency import (
     RuleC002,
     RuleC003,
     RuleC004,
+    RuleC005,
 )
 from predictionio_tpu.analysis.rules_jax import (
     RuleJ001,
@@ -799,6 +800,89 @@ class TestC004:
 
             def launch():
                 return Process()
+        """) == []
+
+
+# -- C005: blocking call inside a Future done-callback ------------------------
+
+class TestC005:
+    def test_fires_on_blocking_method_callback(self):
+        hits = run_rule(RuleC005, """
+            import os
+
+            class Scorer:
+                def submit(self, fut):
+                    fut.add_done_callback(self._on_done)
+
+                def _on_done(self, fut):
+                    os.fsync(self.fd)
+        """)
+        assert [f.rule_id for f in hits] == ["C005"]
+        assert "os.fsync" in hits[0].message
+
+    def test_fires_on_lambda_with_timeoutless_queue_get(self):
+        hits = run_rule(RuleC005, """
+            def wire(fut, queue):
+                fut.add_done_callback(lambda f: queue.get())
+        """)
+        assert [f.rule_id for f in hits] == ["C005"]
+
+    def test_fires_on_other_futures_result(self):
+        # blocking on a DIFFERENT future inside the callback: the classic
+        # flusher-stall shape (callback waits for work the stalled
+        # flusher itself would produce)
+        hits = run_rule(RuleC005, """
+            class Scorer:
+                def submit(self, fut):
+                    fut.add_done_callback(self._on_done)
+
+                def _on_done(self, fut):
+                    return self._other.result()
+        """)
+        assert [f.rule_id for f in hits] == ["C005"]
+        assert "Future.result" in hits[0].message
+
+    def test_fires_one_call_level_deep(self):
+        # the callback looks clean but forwards to a helper that sleeps
+        hits = run_rule(RuleC005, """
+            import time
+
+            class Scorer:
+                def submit(self, fut):
+                    fut.add_done_callback(
+                        lambda f: self._deliver(f, self.worker)
+                    )
+
+                def _deliver(self, fut, worker):
+                    while True:
+                        time.sleep(0.002)
+        """)
+        assert [f.rule_id for f in hits] == ["C005"]
+
+    def test_silent_on_own_resolved_future_and_nonblocking_work(self):
+        # .result() on the callback's OWN argument is non-blocking (the
+        # future is resolved by contract), including forwarded one call
+        # deep -- the serving fast path's real shape: non-blocking ring
+        # push, overflow parked on the retry queue, never waited for
+        assert run_rule(RuleC005, """
+            class Scorer:
+                def submit(self, fut, box):
+                    fut.add_done_callback(lambda f: box.append(f.result()))
+                    fut.add_done_callback(self._on_done)
+
+                def _on_done(self, future):
+                    response = future.result()
+                    try:
+                        self.ring.push(response)
+                    except RingFull:
+                        self.retry.add(response)
+        """) == []
+
+    def test_silent_on_queue_ops_with_timeout_or_nowait(self):
+        assert run_rule(RuleC005, """
+            def wire(fut, queue):
+                fut.add_done_callback(lambda f: queue.put(f, timeout=0.1))
+                fut.add_done_callback(lambda f: queue.put_nowait(f))
         """) == []
 
 

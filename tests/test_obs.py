@@ -1119,6 +1119,7 @@ pio_serving_batch_size_count %d
             'pio_frontend_requests_total{status="2xx",worker="0"} %d\n'
             'pio_frontend_requests_total{status="2xx",worker="1"} %d\n'
             "pio_serving_queue_depth 3\n"
+            "pio_scorer_wakeups_per_request 2.0\n"
         )
 
         def snap(t, a, b):
@@ -1134,12 +1135,15 @@ pio_serving_batch_size_count %d
         # (100 + 100) forwarded requests over 2 s, summed across workers
         assert stats["frontend_qps"] == pytest.approx(100.0)
         assert stats["ingest_queue_depth"] == 3
+        assert stats["wakeups_per_request"] == pytest.approx(2.0)
         frame = render([stats], [snap(102.0, 200, 150)])
-        assert "WKR" in frame
+        assert "WKR" in frame and "WAKE" in frame
         row = next(l for l in frame.splitlines() if "http://x:1" in l)
-        # WKR sits 4th from the end since the continuous-learning columns
-        # (MODEL/SWAP/LAG, dashes here) landed after it
-        assert row.split()[-4] == "2"
+        # WKR sits 5th from the end: the WAKE (scorer wakeups/request)
+        # and continuous-learning columns (MODEL/SWAP/LAG, dashes here)
+        # landed after it
+        assert row.split()[-5] == "2"
+        assert row.split()[-4] == "2.0"  # the measured wakeup budget
 
     def test_parse_prometheus(self):
         from predictionio_tpu.obs.top import parse_prometheus
@@ -1489,6 +1493,13 @@ class TestQueryServerTracing:
                 # sane non-negative duration and the worker's identity
                 assert ring_span["durationMs"] >= 0.0
                 assert ring_span["attrs"]["worker"] in ("0", "1")
+                # the async fast path: the root span is an explicit
+                # handle -- started on the ring consumer, FINISHED from
+                # the micro-batcher's flusher via the future callback
+                root_span = next(
+                    s for s in spans if s["op"] == "POST /queries.json"
+                )
+                assert root_span["thread"] == "pio-microbatcher"
             exec_ids = {
                 next(
                     s["spanId"]
