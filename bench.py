@@ -686,15 +686,21 @@ def secondary_main(result_path: str) -> None:
         """#10: the `pio check` static-analysis gate as a zero-cost
         regression metric. `analysis_findings_total` (unsuppressed) must
         stay 0 -- tier-1 gates it -- and `suppressed` (the committed
-        baseline) should only ever ratchet down. No JAX, identical on CPU
-        and TPU children."""
+        baseline) should only ever ratchet down.
+        `analysis_runtime_seconds` is the full interprocedural sweep
+        (parallel parse + package index + every rule): the tier-1 gate
+        enforces <10 s on the 2-core box, and this metric is the trend
+        line that shows when the deepening analysis starts eating that
+        budget. No JAX, identical on CPU and TPU children."""
         from predictionio_tpu.analysis.engine import (
             apply_baseline,
             check_paths,
             load_baseline,
         )
 
+        t0 = time.perf_counter()
         findings = check_paths()
+        runtime_s = time.perf_counter() - t0
         unsuppressed, suppressed, stale = apply_baseline(
             findings, load_baseline()
         )
@@ -703,6 +709,7 @@ def secondary_main(result_path: str) -> None:
             by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
         return {
             "analysis_findings_total": len(unsuppressed),
+            "analysis_runtime_seconds": round(runtime_s, 3),
             "suppressed": len(suppressed),
             "stale_baseline": len(stale),
             "findings_by_rule": by_rule,

@@ -1,28 +1,43 @@
-"""``pio check``: JAX-aware static analysis + concurrency lint.
+"""``pio check``: JAX-aware static analysis + interprocedural
+concurrency lint.
 
-Rule families (catalog with incidents: ``docs/static_analysis.md``):
+Rule families (catalog with incidents: ``docs/static_analysis.md``;
+``pio check --explain RULE`` prints any entry):
 
 - **J-series** (``rules_jax``): the jax version-drift and tracing
   invariants -- drift-shim policy (J001), legacy donation miscompile
   (J002), control flow on tracers (J003), host sync inside jit (J004),
-  the 0.4.37 concat+reshard GSPMD miscompile (J005).
-- **C-series** (``rules_concurrency``): lock-order cycles (C001),
-  blocking I/O under a lock (C002), cross-thread unlocked mutation (C003).
+  the 0.4.37 concat+reshard GSPMD miscompile (J005), loop-invariant
+  h2d transfers (J006).
+- **C-series** (``rules_concurrency``): built on the phase-2 whole-
+  package core -- call graph (``callgraph``), thread-role inference
+  (``threadroles``), lockset dataflow (``locksets``), shared via
+  ``packageindex``. Lock-order cycles over call paths (C001), blocking
+  I/O under caller-held locks (C002), fork-after-threads (C004),
+  blocking calls reachable from flusher callbacks / event loops (C005),
+  and the Eraser-style lockset race detector (C006, which replaced
+  C003's allowlisted per-module walk).
 
 ``analysis/baseline.json`` suppresses accepted findings (with mandatory
 justifications); the tier-1 gate in ``tests/test_analysis.py`` asserts
-zero unsuppressed findings over the package. ``analysis/lockwatch.py`` is
-the runtime companion validating C001 against actual acquisition orders
-under pytest.
+zero unsuppressed findings over the package. ``analysis/lockwatch.py``
+is the runtime companion: it validates C001 against actual acquisition
+orders under pytest and records the held lockset at every acquisition so
+C006 findings can cite runtime evidence.
 """
 
 from predictionio_tpu.analysis.engine import (  # noqa: F401
     Finding,
     all_rules,
     apply_baseline,
+    changed_files,
     check_paths,
+    explain,
     load_baseline,
+    parse_files,
     parse_source,
+    render_rule_table,
     run_cli,
     self_check,
+    update_docs,
 )

@@ -1,5 +1,6 @@
-"""``python -m predictionio_tpu.analysis [--self-check] [paths...]`` --
-the same engine ``pio check`` fronts, importable without the CLI."""
+"""``python -m predictionio_tpu.analysis [--self-check] [--explain RULE]
+[--changed] [paths...]`` -- the same engine ``pio check`` fronts,
+importable without the CLI."""
 
 import sys
 

@@ -1,12 +1,15 @@
 """Rule engine: parse the package, run the J/C rule families, report.
 
-The analyzer is deliberately dependency-free (``ast`` + a light lock-region
-walk, no typeshed, no import-time execution of the analyzed code): it has to
-run inside tier-1 on a 2-core box in single-digit seconds, and it encodes
-THIS repo's invariants -- the jax version-drift shim policy, the
-never-donate-sharded-optimizer-state rule, the no-blocking-I/O-under-a-lock
-rule -- not a general Python lint. See ``docs/static_analysis.md`` for the
-rule catalog and the incident each rule encodes.
+The analyzer is deliberately dependency-free (``ast`` + the phase-2
+whole-package core -- call graph, thread roles, lockset dataflow -- no
+typeshed, no import-time execution of the analyzed code): it has to run
+inside tier-1 on a 2-core box in single-digit seconds (files parse in
+parallel, the package index builds once), and it encodes THIS repo's
+invariants -- the jax version-drift shim policy, the
+never-donate-sharded-optimizer-state rule, the no-blocking-I/O-under-
+a-lock rule, the Eraser-style lockset race predicate -- not a general
+Python lint. See ``docs/static_analysis.md`` for the rule catalog and
+the incident each rule encodes (``--explain RULE`` prints any entry).
 
 Baseline contract (``analysis/baseline.json``): accepted findings are keyed
 by ``(rule, path, symbol)`` -- line-independent, so unrelated edits don't
@@ -21,6 +24,9 @@ from __future__ import annotations
 import ast
 import json
 import os
+import re
+import subprocess
+import textwrap
 from dataclasses import dataclass, field, asdict
 from typing import Iterable, Iterator
 
@@ -147,10 +153,31 @@ def check_context(ctx: ModuleContext, rules: list) -> list[Finding]:
     return findings
 
 
+def parse_files(files: list[str], root: str | None = None) -> list[ModuleContext]:
+    """Parse many files concurrently (reads overlap; the 2-core sweep
+    budget in bench #10 is paid here). Unparseable files are skipped,
+    matching ``parse_module``."""
+    root = root or repo_root()
+    if len(files) < 8:
+        ctxs = [parse_module(p, root) for p in files]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = min(8, max(2, os.cpu_count() or 2))
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            ctxs = list(ex.map(lambda p: parse_module(p, root), files))
+    return [c for c in ctxs if c is not None]
+
+
 def check_paths(
     paths: Iterable[str] | None = None, rules: list | None = None
 ) -> list[Finding]:
-    """Run the rule set over files/directories; defaults to the package."""
+    """Run the rule set over files/directories; defaults to the package.
+
+    Per-module rules run on each file independently; package rules
+    (``check_package``) run ONCE over a shared :class:`PackageIndex`
+    built from every parsed file -- scoping the paths scopes the
+    interprocedural horizon with them."""
     rules = rules if rules is not None else all_rules()
     root = repo_root()
     files: list[str] = []
@@ -159,13 +186,44 @@ def check_paths(
             files.extend(iter_py_files(p))
         else:
             files.append(p)
+    contexts = parse_files(files, root)
+    module_rules = [r for r in rules if not hasattr(r, "check_package")]
+    package_rules = [r for r in rules if hasattr(r, "check_package")]
     findings: list[Finding] = []
-    for path in files:
-        ctx = parse_module(path, root)
-        if ctx is not None:
-            findings.extend(check_context(ctx, rules))
+    for ctx in contexts:
+        findings.extend(check_context(ctx, module_rules))
+    if package_rules:
+        from predictionio_tpu.analysis.packageindex import PackageIndex
+
+        index = PackageIndex.build(contexts)
+        for rule in package_rules:
+            findings.extend(rule.check_package(index))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
     return findings
+
+
+def changed_files() -> list[str]:
+    """Repo-relative ``.py`` files the working tree has touched vs HEAD
+    (staged, unstaged, and untracked) -- the ``pio check --changed``
+    pre-commit scope."""
+    root = repo_root()
+    out: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD", "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, cwd=root, capture_output=True, text=True, timeout=30
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)} failed: {proc.stderr.strip() or 'not a git repo?'}"
+            )
+        out.update(line.strip() for line in proc.stdout.splitlines())
+    return sorted(
+        f for f in out
+        if f.endswith(".py") and os.path.exists(os.path.join(root, f))
+    )
 
 
 # -- baseline -----------------------------------------------------------------
@@ -311,6 +369,107 @@ def self_check(baseline_path: str | None = None) -> list[str]:
     return problems
 
 
+# -- the incident catalog (docstrings ARE the docs) ---------------------------
+
+_INCIDENT_RE = re.compile(r"\bIncident\b")
+
+#: markers the generated tables live between in docs/static_analysis.md
+DOCS_TABLE_BEGIN = "<!-- BEGIN GENERATED RULE TABLE: {family} (pio check --update-docs) -->"
+DOCS_TABLE_END = "<!-- END GENERATED RULE TABLE: {family} -->"
+
+
+def _split_doc(rule) -> tuple[str, str]:
+    """A rule docstring split into (what it flags, the incident it
+    encodes) at the first 'Incident' sentence. The docstring is the
+    single source: ``--explain`` prints it whole, the docs table renders
+    this split -- CLI and docs cannot drift."""
+    doc = textwrap.dedent(
+        (type(rule).__doc__ or "").strip("\n")
+    ).strip()
+    # dedent misses the first line (no leading whitespace); normalize all
+    doc = "\n".join(line.strip() for line in doc.splitlines())
+    m = _INCIDENT_RE.search(doc)
+    if m is None:
+        return doc, ""
+    return doc[: m.start()].rstrip(" .\n"), doc[m.start():]
+
+
+def _table_cell(text: str) -> str:
+    text = " ".join(text.split())
+    text = re.sub(r"^Incident[^:]*:\s*", "", text)
+    return text.replace("|", "\\|")
+
+
+def explain(rule_id: str) -> str:
+    """The incident-catalog entry for one rule (``--explain RULE``):
+    the rule class docstring, verbatim."""
+    rules = {r.rule_id: r for r in all_rules()}
+    rule = rules.get(rule_id.upper())
+    if rule is None:
+        raise ValueError(
+            f"unknown rule id {rule_id!r} (known: {sorted(rules)})"
+        )
+    flags, incident = _split_doc(rule)
+    body = flags + ("\n\n" + incident if incident else "")
+    return f"{rule.rule_id} ({rule.severity})\n\n{body}\n"
+
+
+def render_rule_table(family: str) -> str:
+    """The markdown rule table for one family ('J' or 'C'), generated
+    from the rule docstrings. Embedded in docs/static_analysis.md
+    between the DOCS_TABLE markers by ``--update-docs``; a tier-1 test
+    asserts the committed docs match this output."""
+    rows = [
+        "| rule | severity | what it flags | the incident it encodes |",
+        "|---|---|---|---|",
+    ]
+    for rule in sorted(all_rules(), key=lambda r: r.rule_id):
+        if not rule.rule_id.startswith(family):
+            continue
+        flags, incident = _split_doc(rule)
+        rows.append(
+            f"| {rule.rule_id} | {rule.severity} | {_table_cell(flags)} "
+            f"| {_table_cell(incident) or '—'} |"
+        )
+    return "\n".join(rows)
+
+
+def default_docs_path() -> str:
+    return os.path.join(repo_root(), "docs", "static_analysis.md")
+
+
+def update_docs(path: str | None = None) -> list[str]:
+    """Rewrite the generated rule-table blocks in the docs file; returns
+    the families replaced. A family whose markers are missing raises --
+    silently skipping one would leave its table stale while reporting
+    success."""
+    path = path or default_docs_path()
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    missing = [
+        family for family in ("J", "C")
+        if DOCS_TABLE_BEGIN.format(family=family) not in text
+        or DOCS_TABLE_END.format(family=family) not in text
+    ]
+    if missing:
+        raise ValueError(
+            f"docs rule-table markers missing for famil"
+            f"{'y' if len(missing) == 1 else 'ies'} {', '.join(missing)} "
+            f"in {path}"
+        )
+    replaced = []
+    for family in ("J", "C"):
+        begin = DOCS_TABLE_BEGIN.format(family=family)
+        end = DOCS_TABLE_END.format(family=family)
+        head, rest = text.split(begin, 1)
+        _, tail = rest.split(end, 1)
+        text = f"{head}{begin}\n{render_rule_table(family)}\n{end}{tail}"
+        replaced.append(family)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+    return replaced
+
+
 def add_check_arguments(parser) -> None:
     """The ``pio check`` flag surface, defined ONCE -- shared by the
     standalone CLI (``python -m predictionio_tpu.analysis``) and the
@@ -318,6 +477,22 @@ def add_check_arguments(parser) -> None:
     parser.add_argument(
         "paths", nargs="*",
         help="files/dirs to analyze (default: the predictionio_tpu package)",
+    )
+    parser.add_argument(
+        "--explain", default=None, metavar="RULE",
+        help="print RULE's incident-catalog entry (the rule docstring "
+        "that also generates the docs table) and exit",
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="scope the report to files git says changed vs HEAD "
+        "(pre-commit use; the interprocedural analysis still sees the "
+        "whole package, and out-of-scope baseline entries never go stale)",
+    )
+    parser.add_argument(
+        "--update-docs", action="store_true",
+        help="regenerate the rule tables in docs/static_analysis.md "
+        "from the rule docstrings",
     )
     parser.add_argument(
         "--rules", default=None,
@@ -372,6 +547,23 @@ def _entry_in_scope(entry: dict, ran: set[str], scope) -> bool:
 
 def run_with_args(args) -> int:
     """Execute a parsed ``pio check`` invocation."""
+    if getattr(args, "explain", None):
+        try:
+            print(explain(args.explain), end="")
+        except ValueError as exc:
+            print(f"Error: {exc}")
+            return 2
+        return 0
+    if getattr(args, "update_docs", False):
+        try:
+            replaced = update_docs()
+        except (ValueError, OSError) as exc:
+            print(f"Error: {exc}")
+            return 2
+        print(
+            f"docs rule table(s) regenerated: {', '.join(replaced)}-series"
+        )
+        return 0
     if args.self_check:
         problems = self_check(
             None if args.baseline in (None, "none") else args.baseline
@@ -394,9 +586,34 @@ def run_with_args(args) -> int:
     if missing:
         print(f"Error: no such file or directory: {', '.join(missing)}")
         return 2
-    findings = check_paths(args.paths or None, rules)
-    ran = {r.rule_id for r in rules}
-    scope = _scope(args.paths)
+    if getattr(args, "changed", False):
+        # the full package still parses (package rules need the whole
+        # call graph); only the REPORT narrows to the changed files,
+        # with the same path-scoped baseline semantics as explicit
+        # paths: out-of-scope entries are never reported stale
+        if args.paths:
+            print("Error: --changed and explicit paths are mutually exclusive")
+            return 2
+        try:
+            changed = changed_files()
+        except (RuntimeError, OSError, subprocess.SubprocessError) as exc:
+            print(f"Error: --changed needs git: {exc}")
+            return 2
+        root = repo_root()
+        pkg_rel = os.path.relpath(package_root(), root).replace(os.sep, "/")
+        extra = [
+            os.path.join(root, f) for f in changed
+            if not f.startswith(pkg_rel + "/")
+        ]
+        findings = check_paths([package_root()] + extra, rules)
+        changed_set = set(changed)
+        findings = [f for f in findings if f.path in changed_set]
+        ran = {r.rule_id for r in rules}
+        scope = (changed_set, [])
+    else:
+        findings = check_paths(args.paths or None, rules)
+        ran = {r.rule_id for r in rules}
+        scope = _scope(args.paths)
     if args.update_baseline:
         if args.baseline == "none":
             print("Error: --update-baseline with --baseline none makes no sense")

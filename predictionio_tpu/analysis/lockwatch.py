@@ -1,7 +1,11 @@
-"""Runtime lock-order watcher: C001's reality check.
+"""Runtime lock-order watcher: C001's reality check, C006's witness.
 
-The static C001 rule reasons about lexical ``with`` nesting; this module
-records what threads ACTUALLY do. ``install()`` replaces
+The static C001/C006 rules reason about lexical nesting and call-graph
+paths; this module records what threads ACTUALLY do. Beyond order edges,
+every acquisition records the lockset HELD at that moment (``held_at``),
+and ``runtime_witness()`` renders what tier-1 observed at the lock sites
+a static C006 race finding names -- or their absence -- so the repo-wide
+gate can attach runtime evidence to a static report. ``install()`` replaces
 ``threading.Lock``/``threading.RLock`` with factories that hand
 predictionio_tpu code (decided by the caller's module at construction
 time -- one frame peek per ``Lock()``, no ``sys.settrace``) a thin wrapper.
@@ -44,6 +48,12 @@ class LockWatch:
     #: (site_a, site_b) -> thread name that first recorded the edge
     edges: dict = field(default_factory=dict)
     inversions: list = field(default_factory=list)
+    #: site -> set of frozensets: every distinct lockset observed HELD at
+    #: an acquisition of that site (the empty frozenset = acquired bare).
+    #: This is the runtime half of C006: a static disjoint-lockset race
+    #: finding can cite what locks tier-1 actually held at the sites in
+    #: question -- or their absence.
+    held_at: dict = field(default_factory=dict)
     _state: threading.local = field(default_factory=threading.local)
     _mutex: threading.Lock = field(default_factory=threading.Lock)
 
@@ -64,10 +74,20 @@ class LockWatch:
             a, b = entry[0].site, lock.site
             if a != b:
                 new_edges.append((a, b))
+        held_sites = frozenset(e[0].site for e in held)
         held.append([lock, 1])
-        if not new_edges:
+        # racy membership pre-check (GIL-safe): the steady state -- this
+        # site already observed with this held-set, no new edges -- pays
+        # no mutex at all, so watched locks stay near-transparent
+        known = self.held_at.get(lock.site)
+        need_record = known is None or held_sites not in known
+        if not new_edges and not need_record:
             return
         with self._mutex:
+            if need_record:
+                self.held_at.setdefault(lock.site, set()).add(held_sites)
+            if not new_edges:
+                return
             for a, b in new_edges:
                 self.edges.setdefault((a, b), threading.current_thread().name)
                 if (b, a) in self.edges:
@@ -92,6 +112,41 @@ class LockWatch:
 
     def wrap(self, real_lock, site: str) -> "_WatchedLock":
         return _WatchedLock(real_lock, site, self)
+
+    def runtime_witness(self, sites: "list[str]") -> str:
+        """What the run actually observed at the given lock construction
+        sites (``module:lineno``): exact site first, tolerating a +/-2
+        line drift between the static declaration line and the runtime
+        construction frame (multi-line assignments) -- never the whole
+        module, which would present other locks' acquisitions as
+        evidence for this one. Used by the tier-1 gate to annotate C006
+        findings with runtime evidence -- or its absence."""
+        if not sites:
+            return "no lock sites to witness"
+        with self._mutex:
+            snapshot = {k: set(v) for k, v in self.held_at.items()}
+        parts = []
+        for site in sites:
+            module, _, line_s = site.rpartition(":")
+            hits = {k: v for k, v in snapshot.items() if k == site}
+            if not hits and line_s.isdigit():
+                line = int(line_s)
+                hits = {
+                    k: v for k, v in snapshot.items()
+                    if k.rsplit(":", 1)[0] == module
+                    and k.rsplit(":", 1)[1].isdigit()
+                    and abs(int(k.rsplit(":", 1)[1]) - line) <= 2
+                }
+            if not hits:
+                parts.append(f"{site}: never acquired under lockwatch")
+                continue
+            for k, locksets in sorted(hits.items()):
+                rendered = sorted(
+                    "{" + ", ".join(sorted(ls)) + "}" if ls else "{}"
+                    for ls in locksets
+                )
+                parts.append(f"{k}: acquired holding {', '.join(rendered)}")
+        return "; ".join(parts)
 
 
 class _WatchedLock:

@@ -1,0 +1,48 @@
+"""The shared whole-package analysis state: one build, every rule reads.
+
+``PackageIndex`` bundles the three phase-2 layers -- call graph
+(``callgraph``), thread roles (``threadroles``), lockset model
+(``locksets``) -- built ONCE per ``pio check`` run over every parsed
+module and handed to each package-level rule. Rules must not rebuild any
+layer themselves: the sweep's time budget (<10 s on the 2-core box,
+bench #10) is paid for by sharing this index.
+
+``PackageRule`` is the base for rules that need cross-module context;
+its ``check(ctx)`` convenience wraps a single module in a one-file index
+so rule fixtures (``tests/test_analysis.py``) keep the same entry point
+as per-module rules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from predictionio_tpu.analysis.callgraph import CallGraph
+from predictionio_tpu.analysis.locksets import LockModel
+from predictionio_tpu.analysis.threadroles import RoleInference
+
+
+@dataclass
+class PackageIndex:
+    contexts: list
+    graph: CallGraph
+    roles: RoleInference
+    locks: LockModel
+
+    @classmethod
+    def build(cls, contexts: list) -> "PackageIndex":
+        graph = CallGraph(contexts)
+        return cls(
+            contexts=contexts,
+            graph=graph,
+            roles=RoleInference(graph),
+            locks=LockModel(graph),
+        )
+
+
+class PackageRule:
+    """Base for rules whose ``check_package(index)`` needs the whole
+    program; ``check(ctx)`` adapts a single module for fixtures."""
+
+    def check(self, ctx):
+        yield from self.check_package(PackageIndex.build([ctx]))

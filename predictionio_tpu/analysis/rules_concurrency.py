@@ -1,409 +1,162 @@
-"""C-series rules: lock ordering, blocking-I/O-under-lock, unlocked shared
-mutation. Built on a light lock-region walk (lexical ``with <lock>:``
-nesting plus one level of intra-module call propagation) -- not a full CFG,
-but exactly the shapes the PR-2/PR-3 races took.
+"""C-series rules: the concurrency invariants, phase 2.
+
+Phase 1 (PRs 5-12) walked one module at a time -- lexical ``with``
+nesting plus one level of ``self.`` call propagation -- which matched the
+WAL/snapshot incidents but not the shapes the serving/online tiers took,
+where the hazard spans files and threads. Phase 2 rebuilds the family on
+the whole-package core (``callgraph`` / ``threadroles`` / ``locksets``):
+
+- C001/C002 join locksets over call paths (a blocking call N frames
+  below the lock acquisition is the same stall as one frame below);
+- C005 follows done-callback and event-loop roles through the call
+  graph, including the higher-order hand-offs of the async serving path;
+- C006 is the Eraser-style static lockset race detector that replaces
+  C003: a field written under one thread role and read/written under
+  another with disjoint locksets, package-wide, no module allowlist.
+
+Every rule class docstring IS its incident-catalog entry: ``pio check
+--explain RULE`` prints it, and the rule table in
+``docs/static_analysis.md`` is generated from it (the paragraph starting
+``Incident`` becomes the incident column).
 """
 
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass, field
 from typing import Iterator
 
-from predictionio_tpu.analysis.astutil import call_name, dotted, keyword, walk_calls
+from predictionio_tpu.analysis.astutil import call_name, dotted
+from predictionio_tpu.analysis.callgraph import _body_walk
 from predictionio_tpu.analysis.engine import Finding, ModuleContext
+from predictionio_tpu.analysis.locksets import blocking_reason
+from predictionio_tpu.analysis.packageindex import PackageIndex, PackageRule
+from predictionio_tpu.analysis.threadroles import CONCURRENT_KINDS
 
-#: C003's blast radius: the modules whose state is touched by both request
-#: threads and background writer/flusher threads
-C003_SCOPE = (
-    "data/ingest.py",
-    "data/wal.py",
-    "data/snapshot.py",
-    "workflow/microbatch.py",
-    "utils/metrics.py",
-    "serving/frontend.py",
-    "serving/procserver.py",
-    # PR 9: the continuous-learning subsystem -- the loop's state is read
-    # by its follow thread and the query server's swap handlers
-    "online/follower.py",
-    "online/foldin.py",
-    "online/registry.py",
-    "online/loop.py",
-)
-
-_LOCK_CTORS = {
-    "threading.Lock", "threading.RLock", "threading.Condition",
-    "Lock", "RLock", "Condition",
-}
-
-#: attribute calls that mutate a container in place
-_MUTATORS = {
-    "append", "extend", "insert", "pop", "remove", "clear", "add",
-    "discard", "update", "setdefault", "popitem",
-}
+#: cap on the depth of role-carrying DFS walks (C005/C006); real chains
+#: in this repo are <= 6 hops (ring consumer -> ... -> retry queue)
+_MAX_DEPTH = 12
 
 
-def _lock_index(ctx: ModuleContext) -> "_LockIndex":
-    """One _LockIndex per module, shared by the three C rules."""
-    cached = ctx.symbols.get("__lock_index__")
-    if cached is None:
-        cached = _LockIndex(ctx)
-        ctx.symbols["__lock_index__"] = cached
-    return cached
+def _chain_text(hops: list[str]) -> str:
+    return " -> ".join(hops)
 
 
-def _lock_id(expr: ast.AST) -> str | None:
-    """Normalize a lock reference: ``self._lock`` -> ``_lock``, a bare
-    module-level ``_lock`` stays ``_lock``."""
-    d = dotted(expr)
-    if d is None:
-        return None
-    if d.startswith("self."):
-        return d[len("self."):]
-    return d
+class RuleC001(PackageRule):
+    """Inconsistent lock-acquisition order: lock A held while acquiring
+    B on one path, B held while acquiring A on another -- a cycle in the
+    package lock graph, now joined over full call-graph reachability
+    (the acquisition of B may sit any number of frames below the holder
+    of A). A cycle is a deadlock waiting for the right interleaving.
+    Validated at runtime by ``analysis/lockwatch.py``, which records
+    actual acquisition-order edges (and the held lockset at every
+    acquisition) under tier-1.
 
-
-class _LockIndex:
-    """Per-module lock inventory + per-function lock-region facts."""
-
-    def __init__(self, ctx: ModuleContext):
-        self.ctx = ctx
-        self.locks: set[str] = set()
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                if call_name(node.value) in _LOCK_CTORS:
-                    for t in node.targets:
-                        lid = _lock_id(t)
-                        if lid:
-                            self.locks.add(lid)
-        #: qualname -> _FuncFacts
-        self.funcs: dict[str, "_FuncFacts"] = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                # symbols[] maps a def to its own qualname ("Class.method")
-                qual = ctx.symbols.get(id(node), node.name)
-                facts = _FuncFacts(qual, node)
-                _walk_regions(node, self.locks, facts)
-                self.funcs[qual] = facts
-
-    def lookup(self, caller_qual: str, callee: str) -> "_FuncFacts | None":
-        """Resolve ``self.foo()`` / ``foo()`` to a function in this module;
-        prefers the caller's own class."""
-        if callee.startswith("self."):
-            name = callee[len("self."):]
-            cls = caller_qual.rsplit(".", 1)[0] if "." in caller_qual else ""
-            hit = self.funcs.get(f"{cls}.{name}")
-            if hit is not None:
-                return hit
-            for qual, facts in self.funcs.items():
-                if qual.endswith(f".{name}"):
-                    return facts
-            return None
-        return self.funcs.get(callee)
-
-
-@dataclass
-class _FuncFacts:
-    qual: str
-    node: ast.AST
-    #: (lock, frozenset(held), line) at each with-acquisition
-    acquisitions: list = field(default_factory=list)
-    #: (reason, frozenset(held), line) for blocking calls
-    blocking: list = field(default_factory=list)
-    #: (callee dotted name, frozenset(held), line) for calls made
-    calls: list = field(default_factory=list)
-    #: (attr, frozenset(held), line) for self-attribute mutations
-    mutations: list = field(default_factory=list)
-
-
-def _walk_regions(fn: ast.AST, locks: set[str], facts: _FuncFacts) -> None:
-    def visit(node: ast.AST, held: tuple) -> None:
-        if isinstance(node, ast.With):
-            acquired = []
-            for item in node.items:
-                lid = _lock_id(item.context_expr)
-                if lid is not None and lid in locks:
-                    facts.acquisitions.append((lid, frozenset(held), node.lineno))
-                    acquired.append(lid)
-            inner = held + tuple(a for a in acquired if a not in held)
-            for child in node.body:
-                visit(child, inner)
-            return
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
-            return  # nested defs run on their own call stack
-        if isinstance(node, ast.Call):
-            name = call_name(node)
-            # lock.acquire() outside a with-statement counts as an
-            # acquisition event (region tracking stays with-based)
-            if isinstance(node.func, ast.Attribute) and node.func.attr == "acquire":
-                lid = _lock_id(node.func.value)
-                if lid in locks:
-                    facts.acquisitions.append((lid, frozenset(held), node.lineno))
-            reason = _blocking_reason(node)
-            if reason is not None:
-                facts.blocking.append((reason, frozenset(held), node.lineno))
-            if name and (name.startswith("self.") or name in ("",) or "." not in name):
-                facts.calls.append((name, frozenset(held), node.lineno))
-            if (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr in _MUTATORS
-            ):
-                recv = dotted(node.func.value) or ""
-                if recv.startswith("self.") and recv.count(".") == 1:
-                    facts.mutations.append(
-                        (recv[len("self."):], frozenset(held), node.lineno)
-                    )
-        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
-            for t in targets:
-                d = dotted(t)
-                if d and d.startswith("self.") and d.count(".") == 1:
-                    facts.mutations.append(
-                        (d[len("self."):], frozenset(held), node.lineno)
-                    )
-        for child in ast.iter_child_nodes(node):
-            visit(child, held)
-
-    for stmt in ast.iter_child_nodes(fn):
-        visit(stmt, ())
-
-
-def _blocking_reason(call: ast.Call) -> str | None:
-    name = call_name(call)
-    if name == "os.fsync":
-        return "os.fsync"
-    if isinstance(call.func, ast.Attribute):
-        attr = call.func.attr
-        if attr == "fsync":
-            return "fsync"
-        # span/trace export under a lock serializes every instrumented hot
-        # path behind the exporter's I/O -- the classic tracing-overhead
-        # incident shape (obs/ policy: ring-buffer under the lock, any
-        # export/flush outside it). `.export()`/`.force_flush()` are the
-        # OTel exporter verbs; a bare `.flush()` only counts on receivers
-        # that look like tracing objects, so file/stream flushes stay
-        # un-flagged.
-        if attr in ("export", "export_spans", "force_flush"):
-            return f"span export .{attr}()"
-        if attr == "flush":
-            recv = (dotted(call.func.value) or "").lower()
-            if any(
-                s in recv for s in ("trace", "span", "exporter", "telemetry")
-            ):
-                return f"span export .{attr}()"
-        if attr in ("execute", "executemany", "commit", "rollback"):
-            return f"SQL .{attr}()"
-        if attr in ("connect", "sendall", "recv", "accept", "makefile"):
-            return f"socket .{attr}()"
-        if attr in ("put", "get"):
-            recv = (dotted(call.func.value) or "").lower()
-            if "queue" in recv or recv in ("q", "self.q"):
-                if keyword(call, "timeout") is not None:
-                    return None
-                block_kw = keyword(call, "block")
-                if block_kw is not None and isinstance(
-                    block_kw.value, ast.Constant
-                ) and block_kw.value.value is False:
-                    return None
-                return f"blocking queue .{attr}() without timeout"
-    if name == "time.sleep":
-        return "time.sleep"
-    if name in ("urllib.request.urlopen", "urlopen"):
-        return "urlopen"
-    return None
-
-
-class RuleC001:
-    """Inconsistent lock-acquisition order (cycle in the module's lock
-    graph). Incident class: the PR-2/PR-3 snapshot-GC and checkpoint-
-    ordering races; a cycle here is a deadlock waiting for the right
-    interleaving. Validated at runtime by ``analysis/lockwatch.py``."""
+    Incident: the PR-2/PR-3 snapshot-GC and checkpoint-ordering races
+    (snapshot GC vs builder, checkpoint vs flush)."""
 
     rule_id = "C001"
     severity = "error"
 
-    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        index = _lock_index(ctx)
-        if len(index.locks) < 2:
-            return
-        # edges: lock A held while acquiring lock B (direct + one level of
-        # intra-module call propagation)
-        edges: dict[tuple[str, str], int] = {}
-        for facts in index.funcs.values():
+    def check_package(self, index: PackageIndex) -> Iterator[Finding]:
+        locks = index.locks
+        contexts = locks.entry_contexts()
+        #: (held lock, acquired lock) -> (path, line) of first sighting
+        edges: dict[tuple, tuple] = {}
+        for fkey, facts in sorted(locks.facts.items()):
+            inherited = [frozenset()] + sorted(
+                contexts.get(fkey, ()), key=sorted
+            )
             for lock, held, line in facts.acquisitions:
-                for h in held:
-                    if h != lock:
-                        edges.setdefault((h, lock), line)
-            for callee, held, line in facts.calls:
-                if not held:
-                    continue
-                target = index.lookup(facts.qual, callee)
-                if target is None:
-                    continue
-                for lock, _, _ in target.acquisitions:
-                    for h in held:
+                for base in inherited:
+                    for h in base | held:
                         if h != lock:
-                            edges.setdefault((h, lock), line)
+                            edges.setdefault(
+                                (h, lock), (facts.info.path, line)
+                            )
         reported: set[frozenset] = set()
-        for (a, b), line in sorted(edges.items(), key=lambda kv: kv[1]):
-            if (b, a) in edges and frozenset((a, b)) not in reported:
-                reported.add(frozenset((a, b)))
-                yield Finding(
-                    self.rule_id, self.severity, ctx.path, line,
-                    "<module>",
-                    f"inconsistent lock order: {a!r} -> {b!r} (line {line}) "
-                    f"but also {b!r} -> {a!r} (line {edges[(b, a)]})",
-                    "pick one global acquisition order and restructure the "
-                    "second site to follow it",
-                )
+        for (a, b), (path, line) in sorted(
+            edges.items(), key=lambda kv: (kv[1], kv[0])
+        ):
+            if (b, a) not in edges or frozenset((a, b)) in reported:
+                continue
+            reported.add(frozenset((a, b)))
+            rpath, rline = edges[(b, a)]
+            sa, sb = index.locks.short_lock(a), index.locks.short_lock(b)
+            yield Finding(
+                self.rule_id, self.severity, path, line,
+                "<module>",
+                f"inconsistent lock order: {sa!r} -> {sb!r} "
+                f"({path}:{line}) but also {sb!r} -> {sa!r} "
+                f"({rpath}:{rline})",
+                "pick one global acquisition order and restructure the "
+                "second site to follow it",
+            )
 
 
-class RuleC002:
-    """Blocking I/O while holding a lock. Incident: the WAL held its writer
-    lock across the group-commit fsync, serializing appenders behind disk
-    latency; same shape as fsync-under-lock in the snapshot store."""
+class RuleC002(PackageRule):
+    """Blocking I/O (fsync, SQL execute/commit, socket calls, span
+    export, ``queue.put/get`` without timeout, ``urlopen``,
+    ``time.sleep``) while holding a lock -- including locks held by a
+    CALLER any number of frames up the call graph; such findings report
+    the witness call path from the acquisition to the blocking call.
+
+    Incident: the WAL held its writer lock across the group-commit
+    fsync, parking every concurrent ``append()`` behind disk latency
+    (fixed in PR 5: dup the fd under the lock, fsync outside); the same
+    shape recurred in the snapshot store and the span exporter."""
 
     rule_id = "C002"
     severity = "warning"
 
-    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        index = _lock_index(ctx)
-        if not index.locks:
-            return
-        for facts in index.funcs.values():
-            for reason, held, line in facts.blocking:
-                if not held:
-                    continue
-                yield Finding(
-                    self.rule_id, self.severity, ctx.path, line,
-                    facts.qual,
-                    f"blocking call ({reason}) while holding "
-                    f"{', '.join(sorted(held))}",
-                    "move the blocking call outside the critical section "
-                    "(capture state under the lock, do I/O after release)",
-                )
-
-
-class RuleC003:
-    """A field mutated from two threads' entry points with no common lock.
-    Scoped to the modules where request threads and background writers
-    share state. Entry points: ``threading.Thread(target=self.X)`` methods
-    (background) vs public methods (request threads)."""
-
-    rule_id = "C003"
-    severity = "error"
-
-    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        if not any(ctx.path.endswith(s) for s in C003_SCOPE):
-            return
-        index = _lock_index(ctx)
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.ClassDef):
-                yield from self._check_class(ctx, index, node)
-
-    def _check_class(self, ctx, index, cls: ast.ClassDef):
-        cls_qual = ctx.symbols.get(id(cls), cls.name)
-        methods = {
-            q.rsplit(".", 1)[1]: f
-            for q, f in index.funcs.items()
-            if q.startswith(f"{cls_qual}.") and q.count(".") == cls_qual.count(".") + 1
-        }
-        bg_roots = set()
-        for call in walk_calls(cls):
-            if call_name(call).endswith("Thread"):
-                kw = keyword(call, "target")
-                if kw is not None:
-                    d = dotted(kw.value) or ""
-                    if d.startswith("self."):
-                        bg_roots.add(d[len("self."):])
-        if not bg_roots:
-            return
-        fg_roots = {
-            name for name in methods
-            if not name.startswith("_") and name not in bg_roots
-        }
-        # attr -> root kind -> list of locksets observed at mutation sites
-        observed: dict[str, dict[str, list]] = {}
-        lines: dict[str, int] = {}
-        for kind, roots in (("bg", bg_roots), ("fg", fg_roots)):
-            for root in roots:
-                for attr, held, line in self._reachable_mutations(
-                    index, cls_qual, methods, root
-                ):
-                    if attr in index.locks:
-                        continue
-                    observed.setdefault(attr, {}).setdefault(kind, []).append(held)
-                    lines.setdefault(attr, line)
-        for attr, by_kind in sorted(observed.items()):
-            if "bg" not in by_kind or "fg" not in by_kind:
-                continue
-            locksets = by_kind["bg"] + by_kind["fg"]
-            common = set(locksets[0])
-            for ls in locksets[1:]:
-                common &= set(ls)
-            if common:
-                continue
-            yield Finding(
-                self.rule_id, self.severity, ctx.path, lines[attr],
-                cls_qual,
-                f"field {attr!r} is mutated from both a background-thread "
-                "entry point and a public (request-thread) method without a "
-                "common lock",
-                "guard every mutation site with one shared lock, or confine "
-                "the field to a single thread",
-            )
-
-    def _reachable_mutations(self, index, cls_qual, methods, root):
-        """Mutations reachable from ``root`` (BFS over self-calls within
-        the class, two levels deep), each with the locks held along the
-        path. ``__init__`` is excluded: it happens-before thread start."""
-        out = []
-        seen: set[tuple[str, frozenset]] = set()
-        queue: list[tuple[str, frozenset, int]] = [(root, frozenset(), 0)]
-        while queue:
-            name, path_held, depth = queue.pop(0)
-            if name == "__init__" or (name, path_held) in seen:
-                continue
-            seen.add((name, path_held))
-            facts = methods.get(name)
-            if facts is None:
-                continue
-            for attr, held, line in facts.mutations:
-                out.append((attr, frozenset(path_held | held), line))
-            if depth >= 2:
-                continue
-            for callee, held, _ in facts.calls:
-                if callee.startswith("self."):
-                    queue.append(
-                        (callee[len("self."):], frozenset(path_held | held), depth + 1)
+    def check_package(self, index: PackageIndex) -> Iterator[Finding]:
+        locks = index.locks
+        contexts = locks.entry_contexts()
+        for fkey, facts in sorted(locks.facts.items()):
+            inherited = sorted(contexts.get(fkey, ()), key=sorted)
+            for reason, held, line, _call in facts.blocking:
+                if held:
+                    yield Finding(
+                        self.rule_id, self.severity, facts.info.path, line,
+                        facts.info.qual,
+                        f"blocking call ({reason}) while holding "
+                        f"{', '.join(sorted(locks.short_lock(h) for h in held))}",
+                        "move the blocking call outside the critical "
+                        "section (capture state under the lock, do I/O "
+                        "after release)",
                     )
-        return out
+                elif inherited:
+                    ls = inherited[0]
+                    chain = locks.context_chain(fkey, ls) + [
+                        f"{facts.info.path}:{facts.info.qual}:{line}"
+                    ]
+                    yield Finding(
+                        self.rule_id, self.severity, facts.info.path, line,
+                        facts.info.qual,
+                        f"blocking call ({reason}) reached with "
+                        f"{', '.join(sorted(locks.short_lock(h) for h in ls))} "
+                        f"held by a caller (call path: {_chain_text(chain)})",
+                        "move the blocking call outside the critical "
+                        "section, or stop calling this helper under the "
+                        "lock",
+                    )
 
 
 class RuleC004:
-    """``fork()``-flavored child creation in a threads-and-locks package.
-    Incident class: the multi-process serving tier (PR 8). Every service
+    """``fork()``-flavored child creation in a threads-and-locks
+    package: ``os.fork()`` / ``os.forkpty()``; ``multiprocessing`` with
+    the ``fork`` start method (explicit, or implied by a default-context
+    ``Process(...)`` -- on Linux the default IS fork); and lock/registry/
+    tracer/batcher-shaped state passed as ``Process`` args (inherited or
+    duplicated across the process boundary, it silently diverges).
+
+    Incident: the multi-process serving tier (PR 8). Every service
     module here starts threads and holds locks (batcher flusher, ingest
-    writer, metrics registry locks, the tracer lock); a ``fork()`` child
-    inherits a snapshot where those locks may be HELD by threads that do
-    not exist in the child -- the next acquire deadlocks forever -- and
-    where registries/rings are silently duplicated, so counters fork too.
-    The fix shape is the one ``serving/procserver.py`` uses: spawn a
-    FRESH interpreter (``subprocess.Popen`` or a ``get_context("spawn")``
-    multiprocessing context) and hand state across explicitly (fds via
-    ``pass_fds``, shared files by path).
-
-    Flags, anywhere in the package:
-
-    - ``os.fork()`` / ``os.forkpty()`` calls;
-    - ``multiprocessing.set_start_method("fork")`` /
-      ``get_context("fork")``;
-    - ``Process(...)`` constructions whose context is the platform
-      default or a fork context (on Linux the default IS fork) -- a
-      ``get_context("spawn")``/``"forkserver"`` context is the negative;
-    - lock/registry/tracer/batcher-shaped state passed as ``Process``
-      args (inherited-across-fork hazard even when it pickles).
-    """
+    writer, metrics registry, tracer), so a forked child inherits
+    possibly-HELD locks with no owner thread -- the next acquire
+    deadlocks forever -- and silently-duplicated registries/rings. The
+    fix shape is ``serving/procserver.py``'s: ``subprocess.Popen`` of a
+    fresh interpreter (or ``get_context("spawn")``), state handed across
+    explicitly -- ring files by path, eventfds via ``pass_fds``."""
 
     rule_id = "C004"
     severity = "error"
@@ -564,118 +317,375 @@ class RuleC004:
         return spawn_ctx, fork_ctx
 
 
-class RuleC005:
-    """Blocking call inside a function passed to
-    ``Future.add_done_callback``. Incident class: the async scorer fast
-    path (PR 12) finishes every ``/queries.json`` request -- plugins,
-    serialization, the completion-ring push -- in a done-callback that
-    runs ON THE MICRO-BATCHER'S FLUSHER THREAD; one blocking call there
-    (fsync, SQL, socket I/O, ``time.sleep``, a timeout-less queue op --
-    the C002 catalog -- or another future's ``.result()``) stalls every
-    in-flight batch, not one request. The correct shape is the
-    completion-retry queue in ``serving/procserver.py``: try once
-    non-blocking, park overflow for a timer thread.
+class RuleC005(PackageRule):
+    """A blocking call (the C002 catalog, plus another future's
+    ``.result()``) anywhere in the call graph below a function passed to
+    ``Future.add_done_callback`` -- the flusher role -- or below a
+    single-threaded ``select`` event loop (the frontend worker's serve
+    loop, the ring consumer). Findings report the witness call path from
+    the registration/loop down to the blocking call. ``.result()`` on
+    the callback's OWN (already-resolved) future argument is exempt,
+    tracked through argument forwarding at any depth; event-loop scans
+    skip socket verbs (the loops' own sockets are non-blocking by
+    construction).
 
-    ``.result()`` on the callback's OWN argument (or a parameter the
-    future was forwarded to, one call level deep) is exempt: a done
-    callback receives an already-resolved future, so that call cannot
-    block. Propagates one level through intra-module calls, the C001
-    pattern."""
+    Incident: the async scorer fast path (PR 12): every
+    ``/queries.json`` response is serialized and pushed to the
+    completion ring from a done-callback running ON THE MICRO-BATCHER'S
+    FLUSHER THREAD -- one blocking call there stalls every in-flight
+    batch, not one request, and the call can hide several frames down
+    (`consumer -> submit_query_async -> finish -> on_done -> deliver`).
+    The fix shape is ``serving/procserver.py``'s ``_CompletionRetry``:
+    one non-blocking push, overflow parked for a timer thread."""
 
     rule_id = "C005"
     severity = "error"
 
-    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        index = _lock_index(ctx)
-        for node in ast.walk(ctx.tree):
-            if not (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "add_done_callback"
-                and node.args
-            ):
+    def check_package(self, index: PackageIndex) -> Iterator[Finding]:
+        reported: set[tuple] = set()
+        for role, entry in index.roles.entries(("callback", "eventloop")):
+            fi = index.graph.functions.get(entry)
+            if fi is None:
                 continue
-            caller_qual = ctx.symbol_for(node)
-            yield from self._check_callback(
-                ctx, index, caller_qual, node.args[0], node.lineno
+            exempt = (
+                frozenset(p for p in fi.params() if p != "self")
+                if role.kind == "callback" else frozenset()
+            )
+            yield from self._scan(
+                index, role, fi, exempt,
+                [f"{fi.path}:{fi.qual}"], set(), reported,
             )
 
-    def _check_callback(
-        self, ctx, index, caller_qual, cb: ast.AST, reg_line: int
+    def _scan(
+        self, index, role, fi, exempt, chain, seen, reported, depth=0
     ) -> Iterator[Finding]:
-        # functools.partial(fn, ...): the callable is the first arg
-        if isinstance(cb, ast.Call) and call_name(cb) in (
-            "partial", "functools.partial"
-        ) and cb.args:
-            cb = cb.args[0]
-        if isinstance(cb, ast.Lambda):
-            params = {a.arg for a in cb.args.args}
-            yield from self._scan(
-                ctx, index, caller_qual, cb, params, set()
-            )
+        state = (fi.key, exempt, role)
+        if state in seen or depth > _MAX_DEPTH:
             return
-        name = dotted(cb)
-        if name is None:
-            return
-        facts = index.lookup(caller_qual, name)
+        seen.add(state)
+        facts = index.locks.facts.get(fi.key)
         if facts is None:
             return
-        yield from self._scan(
-            ctx, index, facts.qual, facts.node,
-            self._params(facts.node), {facts.qual},
+        for call, _held, line in facts.calls:
+            reason = blocking_reason(call)
+            if reason is None and isinstance(call.func, ast.Attribute):
+                if call.func.attr == "result":
+                    recv = dotted(call.func.value) or ""
+                    if recv not in exempt:
+                        reason = "Future.result()"
+            if reason is not None and role.kind == "eventloop" and (
+                reason.startswith("socket .")
+            ):
+                # the loop's own sockets are non-blocking by construction
+                reason = None
+            if reason is not None:
+                key = (fi.path, line, reason)
+                if key in reported:
+                    continue
+                reported.add(key)
+                where = (
+                    "a Future.add_done_callback callback: it runs on the "
+                    "resolving thread (the micro-batcher's flusher on the "
+                    "serving path) and stalls every batch behind it"
+                    if role.kind == "callback" else
+                    "a single-threaded event loop: it stalls every "
+                    "connection and ring the loop services"
+                )
+                yield Finding(
+                    self.rule_id, self.severity, fi.path, line,
+                    fi.qual,
+                    f"blocking call ({reason}) inside {where} "
+                    f"[registered at {role.seed}; call path: "
+                    f"{_chain_text(chain)}]",
+                    "do the work non-blocking and park overflow on "
+                    "another thread (the completion-retry-queue shape "
+                    "in serving/procserver.py)",
+                )
+                continue
+            for target in index.graph.call_targets.get(
+                (fi.path, id(call)), ()
+            ):
+                fwd = self._forwarded(index, fi, call, target, exempt)
+                yield from self._scan(
+                    index, role, target, fwd,
+                    chain + [f"{target.path}:{target.qual}:{line}"],
+                    seen, reported, depth + 1,
+                )
+
+    @staticmethod
+    def _forwarded(index, caller, call, target, exempt) -> frozenset:
+        """Map the caller's exempt (resolved-future) names onto the
+        callee's parameters through this call's arguments."""
+        if not exempt:
+            return frozenset()
+        params = target.params()
+        offset = 1 if params[:1] == ["self"] else 0
+        out = set()
+        for i, arg in enumerate(call.args):
+            d = dotted(arg)
+            if d in exempt and i + offset < len(params):
+                out.add(params[i + offset])
+        for kw in call.keywords:
+            d = dotted(kw.value)
+            if d in exempt and kw.arg in params:
+                out.add(kw.arg)
+        return frozenset(out)
+
+
+class RuleC006(PackageRule):
+    """Eraser-style static lockset race: a field written under one
+    thread role and read/written under a different role with DISJOINT
+    locksets, anywhere in the package. Roles are inferred
+    interprocedurally (``threadroles``): ``Thread(target=...)`` entry
+    points, ``threading.Timer`` bodies, done-callback (flusher)
+    functions, subprocess ``__main__`` entries -- each a distinct
+    concurrent context -- plus the merged "request" role of a class's
+    public methods (counted only when some genuinely concurrent role
+    also touches the class, so single-threaded tool classes stay
+    silent). Locksets join over the witness call path; ``__init__`` and
+    thread-constructing lifecycle methods are happens-before the spawn
+    and excluded. Findings name both roles, their locksets, the witness
+    path, and the lock construction sites so the tier-1 gate can cite
+    lockwatch's runtime evidence.
+
+    Incident: generalizes C003 (which guarded a hand-maintained module
+    allowlist: ingest/WAL/snapshot/microbatch/metrics/serving/online)
+    package-wide after the PR 8-12 tiers spread cross-thread state over
+    modules the allowlist never named -- the ring consumer, the flusher
+    callbacks, the retry timer, and the supervisor all mutate scorer
+    state the request path reads."""
+
+    rule_id = "C006"
+    severity = "error"
+
+    def check_package(self, index: PackageIndex) -> Iterator[Finding]:
+        records = self._collect_accesses(index)
+        confined = self._confined_classes(index)
+        for (ckey, attr), recs in sorted(records.items()):
+            if ckey in confined:
+                continue
+            yield from self._judge(index, ckey, attr, recs)
+
+    # -- access collection --------------------------------------------------
+    def _collect_accesses(self, index: PackageIndex) -> dict:
+        """(class key, attr) -> list of (group, kind, lockset, line,
+        path, func qual, role|None) access records, gathered by walking
+        the call graph from every concurrent role entry and every public
+        request method. Every ``main`` seed folds into ONE group: two
+        ``__main__`` guards are two processes, never two threads of one
+        process."""
+        records: dict = {}
+        lifecycle = self._lifecycle_methods(index)
+        for role, entry in index.roles.entries(CONCURRENT_KINDS):
+            group = "main" if role.kind == "main" else role.label
+            self._dfs(
+                index, entry, frozenset(), group, role,
+                records, {}, lifecycle,
+            )
+        for cinfo in index.graph.classes.values():
+            for name, meth in sorted(cinfo.methods.items()):
+                if name.startswith("_") or meth.key in lifecycle:
+                    continue
+                self._dfs(
+                    index, meth.key, frozenset(), "request", None,
+                    records, {}, lifecycle,
+                )
+        return records
+
+    def _dfs(
+        self, index, fkey, pathheld, group, role, records, visited,
+        lifecycle, depth=0, setup=False,
+    ) -> None:
+        seen = visited.setdefault(group, set())
+        state = (fkey, pathheld, setup)
+        if state in seen or depth > _MAX_DEPTH:
+            return
+        seen.add(state)
+        facts = index.locks.facts.get(fkey)
+        if facts is None:
+            return
+        fi = facts.info
+        if fi.cls is not None and not setup and fi.name != "__init__" and (
+            fkey not in lifecycle
+        ):
+            ckey = (fi.path, fi.cls)
+            for acc in facts.accesses:
+                records.setdefault((ckey, acc.attr), []).append((
+                    group, acc.kind, frozenset(pathheld | acc.held),
+                    acc.line, fi.path, fi.qual, role,
+                ))
+        for call, held, line in facts.calls:
+            for target in index.graph.call_targets.get(
+                (fi.path, id(call)), ()
+            ):
+                # everything reached THROUGH an __init__ (a constructor
+                # called mid-traversal builds a fresh object) is
+                # initialization, happens-before any sharing -- the
+                # Eraser first-thread discount, one level deeper
+                self._dfs(
+                    index, target.key, frozenset(pathheld | held),
+                    group, role, records, visited, lifecycle, depth + 1,
+                    setup or target.name in ("__init__", "__enter__")
+                    or target.key in lifecycle,
+                )
+
+    @staticmethod
+    def _lifecycle_methods(index: PackageIndex) -> set:
+        """Methods whose execution happens-before the threads they
+        spawn: ``__init__``/``__enter__`` plus any method constructing a
+        Thread/Timer. Their field writes are setup, not races (the
+        Eraser initialization discount, statically)."""
+        out: set = set()
+        for cinfo in index.graph.classes.values():
+            for name, meth in cinfo.methods.items():
+                if name in ("__init__", "__enter__"):
+                    out.add(meth.key)
+                    continue
+                for node in _body_walk(meth.node):
+                    if isinstance(node, ast.Call):
+                        cn = call_name(node)
+                        if cn.endswith(("Thread", "Timer")) and cn not in (
+                            "", "current_thread",
+                        ):
+                            out.add(meth.key)
+                            break
+        return out
+
+    # -- the race predicate -------------------------------------------------
+    def _judge(self, index, ckey, attr, recs) -> Iterator[Finding]:
+        path, cls = ckey
+        if self._key_of(index, path, cls, attr) is not None:
+            return  # the field IS a lock; guarding it with itself is fine
+        strong = {
+            r[0] for r in recs
+            if r[6] is not None and r[6].kind in ("thread", "timer", "callback")
+        }
+        if not strong:
+            # no genuinely concurrent role ever touches this class:
+            # "main" and "request" alone are one thread in practice
+            # (tool classes, module mains) -- the C003 precedent kept
+            return
+        groups: dict[str, list] = {}
+        for rec in recs:
+            groups.setdefault(rec[0], []).append(rec)
+        if len(groups) < 2:
+            return
+        # the Eraser predicate: >= 2 roles touch the field, at least one
+        # writes, and no lock is common to every access
+        write_groups = {
+            g for g, rs in groups.items() if any(r[1] == "write" for r in rs)
+        }
+        if not write_groups:
+            return
+        common = None
+        for rs in groups.values():
+            for r in rs:
+                common = set(r[2]) if common is None else (common & r[2])
+        if common:
+            return
+        # report the most race-shaped pair: a write and an access from a
+        # DIFFERENT group with the smallest lockset overlap
+        wrec, orec = None, None
+        best = None
+        for wg in sorted(write_groups):
+            for w in groups[wg]:
+                if w[1] != "write":
+                    continue
+                for og in sorted(groups):
+                    if og == wg:
+                        continue
+                    for o in groups[og]:
+                        overlap = len(w[2] & o[2])
+                        if best is None or overlap < best:
+                            best, wrec, orec = overlap, w, o
+        if wrec is None:
+            return
+        locks_seen = sorted({lk for r in recs for lk in r[2]})
+        sites = [
+            index.locks.lock_sites.get(lk) for lk in locks_seen
+        ]
+        sites = [s for s in sites if s]
+        witness = ""
+        if wrec[6] is not None:
+            hops = index.roles.witness_path((wrec[4], wrec[5]), wrec[6])
+            if hops:
+                witness = f"; role path: {_chain_text(hops)}"
+        lock_note = (
+            "lock sites for runtime witness (lockwatch): "
+            + ", ".join(sites)
+            if sites else "no lock is held at any access site "
+            "(lockwatch has no runtime witness to offer)"
+        )
+        yield Finding(
+            self.rule_id, self.severity, path, wrec[3],
+            f"{cls}.{attr}",
+            f"field {attr!r} of {cls} is written under role {wrec[0]} "
+            f"(locks: {self._lockset_text(index, wrec[2])}) and "
+            f"{orec[1]} under role {orec[0]} at {orec[4]}:{orec[3]} "
+            f"(locks: {self._lockset_text(index, orec[2])}) with no "
+            f"lock common to every access{witness}; {lock_note}",
+            "guard every access with one shared lock, confine the field "
+            "to a single thread, or publish it immutably before the "
+            "thread starts",
         )
 
     @staticmethod
-    def _params(fn: ast.AST) -> set[str]:
-        args = fn.args
-        names = {a.arg for a in args.args + args.kwonlyargs}
-        names.discard("self")
-        return names
-
-    def _scan(
-        self, ctx, index, qual: str, fn: ast.AST, params: set[str],
-        seen: set, depth: int = 0,
-    ) -> Iterator[Finding]:
-        """Walk one callback body (skipping nested defs -- they run on
-        their own call stack) for blocking calls; recurse one level into
-        intra-module callees."""
-        body = fn.body if isinstance(fn.body, list) else [fn.body]
-        stack = list(body)
-        while stack:
-            node = stack.pop()
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+    def _confined_classes(index: PackageIndex) -> set:
+        """Classes whose instances provably never escape one function:
+        constructed only as locals, never published to ``self.attr`` /
+        returned / passed on, and spawning no threads of their own --
+        their fields are thread-confined by construction (the
+        ``_ColumnSpill`` shape: a scratch object built, used, and closed
+        inside one build call)."""
+        published: set = set()
+        constructed: set = set()
+        graph = index.graph
+        for cinfo in graph.classes.values():
+            for types in cinfo.attr_types.values():
+                published.update(t.key for t in types)
+        for fi in graph.functions.values():
+            env = graph._local_env(fi)
+            local_types = {
+                v[1].key: k for k, v in env.items() if v[0] == "type"
+            }
+            constructed.update(local_types)
+            if not local_types:
                 continue
-            if isinstance(node, ast.Call):
-                reason = _blocking_reason(node)
-                if reason is None and isinstance(node.func, ast.Attribute):
-                    if node.func.attr == "result":
-                        recv = dotted(node.func.value) or ""
-                        if recv not in params:
-                            reason = "Future.result()"
-                if reason is not None:
-                    yield Finding(
-                        self.rule_id, self.severity, ctx.path, node.lineno,
-                        qual,
-                        f"blocking call ({reason}) inside a "
-                        "Future.add_done_callback callback: it runs on "
-                        "the resolving thread (the micro-batcher's "
-                        "flusher on the serving path) and stalls every "
-                        "batch behind it",
-                        "do the work non-blocking and park overflow on "
-                        "another thread (the completion-retry-queue "
-                        "shape in serving/procserver.py)",
-                    )
-                elif depth < 1:
-                    name = call_name(node)
-                    if name and (name.startswith("self.") or "." not in name):
-                        callee = index.lookup(qual, name)
-                        if callee is not None and callee.qual not in seen:
-                            yield from self._scan(
-                                ctx, index, callee.qual, callee.node,
-                                self._params(callee.node),
-                                seen | {callee.qual}, depth + 1,
-                            )
-            stack.extend(ast.iter_child_nodes(node))
+            for node in _body_walk(fi.node):
+                # returning or passing the instance publishes it
+                if isinstance(node, ast.Return) and node.value is not None:
+                    t = graph.instance_type(fi, node.value)
+                    if t is not None:
+                        published.add(t.key)
+                    elif isinstance(node.value, ast.Call):
+                        c = graph._resolve_class_expr(fi, node.value.func)
+                        if c is not None:
+                            published.add(c.key)
+                elif isinstance(node, ast.Call):
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        t = graph.instance_type(fi, arg)
+                        if t is not None and isinstance(arg, ast.Name):
+                            published.add(t.key)
+        for role, entry in index.roles.entries(("thread", "timer", "callback")):
+            fi = graph.functions.get(entry)
+            if fi is not None and fi.cls is not None:
+                published.add((fi.path, fi.cls))
+        return constructed - published
+
+    @staticmethod
+    def _key_of(index, path, cls, attr):
+        key = f"{path}:{cls}.{attr}"
+        return key if key in index.locks.lock_sites else None
+
+    @staticmethod
+    def _lockset_text(index, lockset) -> str:
+        if not lockset:
+            return "none"
+        return ", ".join(
+            sorted(index.locks.short_lock(lk) for lk in lockset)
+        )
 
 
-RULES = (RuleC001, RuleC002, RuleC003, RuleC004, RuleC005)
+RULES = (RuleC001, RuleC002, RuleC004, RuleC005, RuleC006)

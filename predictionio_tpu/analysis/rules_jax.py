@@ -356,10 +356,14 @@ class RuleJ002:
 
 
 class RuleJ003:
-    """Python control flow on a traced value inside a jitted scope or
-    Pallas kernel. ``if``/``while``/``assert`` on a ``jnp`` result raises
-    TracerBoolConversionError at trace time at best, silently specializes
-    on a compile-time constant at worst; use lax.cond/select/while_loop."""
+    """Python ``if``/``while``/``assert`` on a ``jnp``-derived value
+    inside a ``@jit`` scope or Pallas kernel (static tests -- ``x is
+    None``, ``len()``, ``.shape`` -- are pruned); use
+    lax.cond/select/while_loop instead.
+
+    Incident: TracerBoolConversionError at trace time at best, silent
+    specialization on a trace-time constant at worst -- the bug class
+    every template trainer hit at least once before the gate existed."""
 
     rule_id = "J003"
     severity = "error"
@@ -390,9 +394,13 @@ class RuleJ003:
 
 
 class RuleJ004:
-    """Host-sync calls (``.item()``, ``float()``, ``np.asarray``) on traced
-    values inside jit: they either fail at trace time or silently force a
-    device->host transfer per call on the serving hot path."""
+    """Host-sync calls (``.item()``, ``float()``/``int()``/``bool()``,
+    ``np.asarray``) on traced values inside jit: they either fail at
+    trace time or silently force a device->host transfer per call on the
+    serving hot path.
+
+    Incident: the NCF serving path once paid ~860 ms/query on a
+    remote-tunnel backend to per-call eager dispatches + host syncs."""
 
     rule_id = "J004"
     severity = "warning"

@@ -523,7 +523,12 @@ class ScorerBridge:
         from predictionio_tpu.serving.frontend import FORWARD_TIMEOUT_S
 
         deadline = time.monotonic() + FORWARD_TIMEOUT_S + 5.0
-        for w in list(self._workers):
+        with self._lock:
+            # the supervisor may have been mid-respawn when _draining
+            # flipped: its install runs under this lock, so snapshot
+            # under it too (pio check C006)
+            workers = list(self._workers)
+        for w in workers:
             timeout = max(deadline - time.monotonic(), 0.1)
             try:
                 w.proc.wait(timeout=timeout)
@@ -551,10 +556,14 @@ class ScorerBridge:
         with self._lock:
             self._draining = True
             self._stopping = True
-        for w in self._workers:
+            # snapshot under the lock: the supervisor may still be
+            # installing a respawned worker into the list (pio check
+            # C006 -- the write side holds this lock too)
+            workers = list(self._workers)
+        for w in workers:
             if kill and w.proc.poll() is None:
                 w.proc.kill()
-        for w in self._workers:
+        for w in workers:
             try:
                 w.proc.wait(timeout=5.0)
             except subprocess.TimeoutExpired:
@@ -566,7 +575,7 @@ class ScorerBridge:
         for t in self._dispatchers:
             t.join(timeout=10.0)
         self._retry.stop()
-        for w in self._workers:
+        for w in workers:
             # a straggler async callback (flusher-side) racing this
             # teardown must see dead and drop, not push into a closed
             # mapping -- the same dead-before-close protocol the
@@ -851,7 +860,8 @@ class ScorerBridge:
                 failures, next_try = backoff[index]
                 if time.monotonic() < next_try:
                     continue
-                old = self._workers[index]
+                with self._lock:
+                    old = self._workers[index]
                 replacement = self._launch(index, old.generation + 1)
                 try:
                     self._await_ready([replacement])

@@ -234,8 +234,9 @@ def register(sub: argparse._SubParsersAction) -> None:
 
     check = sub.add_parser(
         "check",
-        help="static analysis: jax drift-shim + concurrency lint "
-        "(rule catalog: docs/static_analysis.md)",
+        help="static analysis: jax drift-shim + interprocedural "
+        "concurrency lint (thread roles, locksets, race detection; "
+        "rule catalog: docs/static_analysis.md, or --explain RULE)",
     )
     add_check_arguments(check)
     check.set_defaults(func=cmd_check)

@@ -742,7 +742,10 @@ class QueryService:
         holding the permit until a wedged batch resolves -- the sync
         dispatcher's ``result(wait_s)`` backstop, off-thread. Exits
         within a tick of ``close()``."""
-        while not self._async_stop:
+        while True:
+            with self._async_lock:
+                if self._async_stop:
+                    return
             _time.sleep(1.0)
             now = _time.perf_counter()
             fire = []
@@ -923,11 +926,15 @@ class QueryService:
         exits within a tick, so a closed service is fully collectable."""
         if self._batcher is not None:
             self._batcher.close()
-        self._async_stop = True
-        watchdog = self._async_watchdog
+        with self._async_lock:
+            # stop flag and watchdog handle share the async lock with
+            # their writers (pio check C006); the join happens OUTSIDE
+            # it -- the watchdog's loop takes this lock every tick
+            self._async_stop = True
+            watchdog = self._async_watchdog
+            self._async_watchdog = None
         if watchdog is not None:
             watchdog.join(timeout=2.0)
-            self._async_watchdog = None
         with self._async_lock:
             self._async_pending.clear()
 

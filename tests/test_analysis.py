@@ -1,7 +1,10 @@
 """Rule-engine tests: every J/C rule fires on its seeded bug pattern and
-stays silent on the corrected form, the lockwatch runtime detector catches
-a seeded acquisition-order inversion, the baseline machinery ratchets, and
-the repo-wide zero-unsuppressed-findings gate (tier-1)."""
+stays silent on the corrected form, the phase-2 core (call graph, thread
+roles, locksets) resolves its fixture shapes, the lockwatch runtime
+detector catches a seeded acquisition-order inversion and records held
+locksets, the baseline machinery ratchets, the docstring-driven catalog
+stays in sync with the docs, and the repo-wide
+zero-unsuppressed-findings gate (tier-1) holds inside its time budget."""
 
 import json
 import textwrap
@@ -19,12 +22,15 @@ from predictionio_tpu.analysis import (
     self_check,
 )
 from predictionio_tpu.analysis import lockwatch
+from predictionio_tpu.analysis.callgraph import CallGraph
+from predictionio_tpu.analysis.locksets import LockModel
+from predictionio_tpu.analysis.packageindex import PackageIndex
 from predictionio_tpu.analysis.rules_concurrency import (
     RuleC001,
     RuleC002,
-    RuleC003,
     RuleC004,
     RuleC005,
+    RuleC006,
 )
 from predictionio_tpu.analysis.rules_jax import (
     RuleJ001,
@@ -34,11 +40,24 @@ from predictionio_tpu.analysis.rules_jax import (
     RuleJ005,
     RuleJ006,
 )
+from predictionio_tpu.analysis.threadroles import RoleInference
 
 
 def run_rule(rule_cls, src: str, path: str = "predictionio_tpu/pkg/mod.py"):
     ctx = parse_source(textwrap.dedent(src), path)
     return list(rule_cls().check(ctx))
+
+
+def build_index(*sources, paths=None):
+    """PackageIndex over several in-memory modules (cross-module fixtures)."""
+    paths = paths or [
+        f"predictionio_tpu/pkg/mod{i}.py" for i in range(len(sources))
+    ]
+    ctxs = [
+        parse_source(textwrap.dedent(src), path)
+        for src, path in zip(sources, paths)
+    ]
+    return PackageIndex.build(ctxs)
 
 
 # -- J001: drift-shim policy --------------------------------------------------
@@ -439,6 +458,292 @@ class TestJ006:
         """) == []
 
 
+# -- the phase-2 core: call graph ---------------------------------------------
+
+class TestCallGraph:
+    def test_resolves_methods_functions_partial_and_lambda(self):
+        index = build_index("""
+            import functools
+            import threading
+
+            def helper():
+                pass
+
+            class S:
+                def __init__(self):
+                    self._t1 = threading.Thread(target=self._run)
+                    self._t2 = threading.Thread(
+                        target=functools.partial(helper, 1)
+                    )
+                    self._t3 = threading.Thread(target=lambda: helper())
+
+                def _run(self):
+                    helper()
+        """)
+        g = index.graph
+        run = g.function_at("predictionio_tpu/pkg/mod0.py", "S._run")
+        assert run is not None and run.cls == "S"
+        # S._run calls helper (edge resolved)
+        callees = [
+            t.qual for site in g.callees(run.key) for t in site.targets
+        ]
+        assert callees == ["helper"]
+        # lambda registered as its own node, body edge resolved
+        lam = [q for q in g.by_path[run.path].funcs if "<lambda" in q]
+        assert len(lam) == 1
+
+    def test_resolves_factory_returned_def(self):
+        # the jit(make_step(...)) shape _JitIndex parses
+        index = build_index("""
+            def make_step(cfg):
+                def step(batch):
+                    return batch
+                return step
+
+            def build(jit):
+                return jit(make_step(None))
+        """)
+        g = index.graph
+        build_fn = g.function_at("predictionio_tpu/pkg/mod0.py", "build")
+        refs = g.resolve_callable(
+            build_fn, g.callees(build_fn.key)[0].call.args[0]
+        )
+        assert [r.qual for r in refs] == ["make_step.step"]
+
+    def test_cross_module_import_and_attr_type_resolution(self):
+        index = build_index(
+            """
+            class Batcher:
+                def submit(self, q):
+                    return q
+            """,
+            """
+            from predictionio_tpu.pkg.mod0 import Batcher
+
+            class Service:
+                def __init__(self):
+                    self._batcher = Batcher()
+
+                def query(self, q):
+                    return self._batcher.submit(q)
+            """,
+        )
+        g = index.graph
+        query = g.function_at("predictionio_tpu/pkg/mod1.py", "Service.query")
+        targets = [
+            t.qual for site in g.callees(query.key) for t in site.targets
+        ]
+        assert "Batcher.submit" in targets
+
+    def test_higher_order_param_and_attr_binding(self):
+        # the async serving hand-off shape: a lambda rides a parameter,
+        # is published to self.attr, and is finally called through both
+        index = build_index("""
+            class Service:
+                def submit(self, request, on_done):
+                    on_done(request)
+
+            class Bridge:
+                def __init__(self, async_query):
+                    self._async_query = async_query
+
+                def pump(self, msg):
+                    self._async_query(msg, lambda r: self._complete(r))
+
+                def _complete(self, response):
+                    pass
+
+            def wire():
+                service = Service()
+                return Bridge(service.submit)
+        """)
+        g = index.graph
+        pump = g.function_at("predictionio_tpu/pkg/mod0.py", "Bridge.pump")
+        pump_targets = [
+            t.qual for site in g.callees(pump.key) for t in site.targets
+        ]
+        assert "Service.submit" in pump_targets
+        submit = g.function_at("predictionio_tpu/pkg/mod0.py", "Service.submit")
+        submit_targets = [
+            t.qual for site in g.callees(submit.key) for t in site.targets
+        ]
+        assert any("<lambda" in t for t in submit_targets)
+
+    def test_annotation_typed_param_resolution(self):
+        index = build_index("""
+            class Worker:
+                def push(self):
+                    pass
+
+            class Bridge:
+                def deliver(self, w: Worker):
+                    w.push()
+        """)
+        g = index.graph
+        deliver = g.function_at("predictionio_tpu/pkg/mod0.py", "Bridge.deliver")
+        targets = [
+            t.qual for site in g.callees(deliver.key) for t in site.targets
+        ]
+        assert targets == ["Worker.push"]
+
+
+# -- the phase-2 core: thread roles -------------------------------------------
+
+_ROLES_SRC = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._t = threading.Thread(target=self._run)
+            self._timer = threading.Timer(1.0, self._tick)
+
+        def _run(self):
+            self._shared_helper()
+
+        def _tick(self):
+            pass
+
+        def _shared_helper(self):
+            pass
+
+        def wire(self, fut):
+            fut.add_done_callback(self._on_done)
+
+        def _on_done(self, f):
+            pass
+
+    def main():
+        S()
+
+    if __name__ == "__main__":
+        main()
+"""
+
+
+class TestThreadRoles:
+    def test_seeds_and_propagation(self):
+        index = build_index(_ROLES_SRC)
+        roles = index.roles
+        path = "predictionio_tpu/pkg/mod0.py"
+
+        def kinds(qual):
+            return {r.kind for r in roles.roles_of((path, qual))}
+
+        assert "thread" in kinds("S._run")
+        assert "thread" in kinds("S._shared_helper")   # propagated
+        assert "timer" in kinds("S._tick")
+        assert "callback" in kinds("S._on_done")
+        assert "main" in kinds("main")
+
+    def test_witness_path_reconstructs_chain(self):
+        index = build_index(_ROLES_SRC)
+        path = "predictionio_tpu/pkg/mod0.py"
+        role = next(
+            r for r in index.roles.roles_of((path, "S._shared_helper"))
+            if r.kind == "thread"
+        )
+        hops = index.roles.witness_path((path, "S._shared_helper"), role)
+        assert hops[0].endswith("S._run")
+        assert hops[-1].startswith(path)
+
+    def test_select_loop_seeds_eventloop_role(self):
+        index = build_index("""
+            import select
+
+            class Loop:
+                def serve(self):
+                    while True:
+                        ready, _, _ = select.select([], [], [], 0.25)
+                        self._handle(ready)
+
+                def _handle(self, ready):
+                    pass
+        """)
+        path = "predictionio_tpu/pkg/mod0.py"
+        kinds = {
+            r.kind for r in index.roles.roles_of((path, "Loop._handle"))
+        }
+        assert "eventloop" in kinds
+
+
+# -- the phase-2 core: locksets -----------------------------------------------
+
+class TestLocksets:
+    def test_qualified_lock_identity_and_local_regions(self):
+        index = build_index("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def work(self):
+                    with self._lock:
+                        self.x = 1
+                    self.y = 2
+        """)
+        path = "predictionio_tpu/pkg/mod0.py"
+        facts = index.locks.facts[(path, "W.work")]
+        by_attr = {a.attr: a for a in facts.accesses if a.kind == "write"}
+        assert by_attr["x"].held == frozenset({f"{path}:W._lock"})
+        assert by_attr["y"].held == frozenset()
+        assert index.locks.lock_sites[f"{path}:W._lock"].startswith(
+            "predictionio_tpu.pkg.mod0:"
+        )
+
+    def test_class_body_lock_declaration_registered(self):
+        # `class W: _lock = threading.Lock()` (one lock shared by every
+        # instance) must register like phase 1 did: correctly-locked
+        # code stays silent instead of racing with "locks: none"
+        index = build_index("""
+            import threading
+
+            class W:
+                _lock = threading.Lock()
+
+                def __init__(self):
+                    self.count = 0
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._lock:
+                        self.count += 1
+
+                def submit(self, n):
+                    with self._lock:
+                        self.count = n
+        """)
+        path = "predictionio_tpu/pkg/mod0.py"
+        assert f"{path}:W._lock" in index.locks.lock_sites
+        assert list(RuleC006().check_package(index)) == []
+
+    def test_entry_contexts_join_over_call_paths(self):
+        index = build_index("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self._middle()
+
+                def _middle(self):
+                    self._leaf()
+
+                def _leaf(self):
+                    pass
+        """)
+        path = "predictionio_tpu/pkg/mod0.py"
+        contexts = index.locks.entry_contexts()
+        leaf = contexts[(path, "W._leaf")]
+        lockset = frozenset({f"{path}:W._lock"})
+        assert lockset in leaf
+        chain = index.locks.context_chain((path, "W._leaf"), lockset)
+        assert any("W.outer" in hop for hop in chain)
+
+
 # -- C001: lock-order cycles --------------------------------------------------
 
 _C001_BUG = """
@@ -485,6 +790,35 @@ class TestC001:
                 def outer(self):
                     with self._a:
                         self._inner()
+
+                def _inner(self):
+                    with self._b:
+                        pass
+
+                def reverse(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        assert [f.rule_id for f in hits] == ["C001"]
+
+    def test_fires_through_deep_cross_function_chain(self):
+        # phase 2: the acquisition of B sits TWO frames below the holder
+        # of A -- phase 1's one-level propagation missed this
+        hits = run_rule(RuleC001, """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def outer(self):
+                    with self._a:
+                        self._mid()
+
+                def _mid(self):
+                    self._inner()
 
                 def _inner(self):
                     with self._b:
@@ -624,80 +958,32 @@ class TestC002:
                     self._exporter.export(batch)
         """) == []
 
-
-# -- C003: unlocked cross-thread mutation -------------------------------------
-
-_C003_PATH = "predictionio_tpu/data/ingest.py"
-
-_C003_BUG = """
-    import threading
-
-    class P:
-        def __init__(self):
-            self._lock = threading.Lock()
-            self.count = 0
-            self._thread = threading.Thread(target=self._run, daemon=True)
-
-        def _run(self):
-            while True:
-                self.count += 1
-
-        def submit(self, n):
-            self.count = n
-"""
-
-
-class TestC003:
-    def test_fires_on_unlocked_shared_counter(self):
-        hits = run_rule(RuleC003, _C003_BUG, path=_C003_PATH)
-        assert [f.rule_id for f in hits] == ["C003"]
-        assert "'count'" in hits[0].message
-
-    def test_silent_with_common_lock(self):
-        fixed = _C003_BUG.replace(
-            "            while True:\n                self.count += 1",
-            "            while True:\n                with self._lock:\n"
-            "                    self.count += 1",
-        ).replace(
-            "        def submit(self, n):\n            self.count = n",
-            "        def submit(self, n):\n            with self._lock:\n"
-            "                self.count = n",
-        )
-        assert run_rule(RuleC003, fixed, path=_C003_PATH) == []
-
-    def test_silent_when_single_thread_mutates(self):
-        single = _C003_BUG.replace(
-            "        def submit(self, n):\n            self.count = n",
-            "        def submit(self, n):\n            return self.count",
-        )
-        assert run_rule(RuleC003, single, path=_C003_PATH) == []
-
-    def test_out_of_scope_module_ignored(self):
-        assert run_rule(
-            RuleC003, _C003_BUG, path="predictionio_tpu/tools/cli.py"
-        ) == []
-
-    def test_fires_through_helper_call(self):
-        helper = """
+    def test_fires_with_witness_path_when_lock_is_frames_up(self):
+        # phase 2: the blocking call lives in a helper; every caller
+        # holds the lock. The finding lands at the blocking site and
+        # reports the acquisition-to-block call path.
+        hits = run_rule(RuleC002, """
+            import os
             import threading
 
-            class P:
+            class W:
                 def __init__(self):
                     self._lock = threading.Lock()
-                    self.count = 0
-                    self._thread = threading.Thread(target=self._run)
 
-                def _run(self):
-                    self._bump()
+                def sync(self, f):
+                    with self._lock:
+                        self._rotate(f)
 
-                def _bump(self):
-                    self.count += 1
+                def _rotate(self, f):
+                    self._really_rotate(f)
 
-                def submit(self, n):
-                    self.count = n
-        """
-        hits = run_rule(RuleC003, helper, path=_C003_PATH)
-        assert [f.rule_id for f in hits] == ["C003"]
+                def _really_rotate(self, f):
+                    os.fsync(f.fileno())
+        """)
+        assert [f.rule_id for f in hits] == ["C002"]
+        assert hits[0].symbol == "W._really_rotate"
+        assert "call path:" in hits[0].message
+        assert "W.sync" in hits[0].message
 
 
 # -- C004: fork-after-threads / state inherited across fork -------------------
@@ -803,7 +1089,7 @@ class TestC004:
         """) == []
 
 
-# -- C005: blocking call inside a Future done-callback ------------------------
+# -- C005: blocking call below a Future done-callback / event loop ------------
 
 class TestC005:
     def test_fires_on_blocking_method_callback(self):
@@ -859,6 +1145,73 @@ class TestC005:
         """)
         assert [f.rule_id for f in hits] == ["C005"]
 
+    def test_fires_deep_in_call_graph_with_witness_path(self):
+        # phase 2: three frames down, across a higher-order hand-off --
+        # the async fast path's actual shape (consumer -> service ->
+        # on_done -> deliver -> fsync)
+        hits = run_rule(RuleC005, """
+            import os
+
+            class Service:
+                def submit_async(self, request, on_done):
+                    on_done(request)
+
+            class Bridge:
+                def __init__(self):
+                    self._svc = Service()
+
+                def pump(self, fut, msg):
+                    fut.add_done_callback(
+                        lambda f: self._svc.submit_async(
+                            msg, lambda r: self._deliver(r)
+                        )
+                    )
+
+                def _deliver(self, response):
+                    self._really_deliver(response)
+
+                def _really_deliver(self, response):
+                    os.fsync(self.fd)
+        """)
+        assert [f.rule_id for f in hits] == ["C005"]
+        assert hits[0].symbol == "Bridge._really_deliver"
+        assert "call path:" in hits[0].message
+
+    def test_fires_on_sleep_in_select_event_loop(self):
+        hits = run_rule(RuleC005, """
+            import select
+            import time
+
+            class Loop:
+                def serve(self):
+                    while True:
+                        select.select([], [], [], 0.25)
+                        self._service()
+
+                def _service(self):
+                    time.sleep(5.0)
+        """)
+        assert [f.rule_id for f in hits] == ["C005"]
+        assert "event loop" in hits[0].message
+
+    def test_event_loop_socket_verbs_exempt(self):
+        # the frontend shape: the loop's own sockets are non-blocking by
+        # construction, so recv/send/accept in the loop stay silent
+        assert run_rule(RuleC005, """
+            import select
+
+            class Loop:
+                def serve(self, listener):
+                    while True:
+                        select.select([listener], [], [], 0.25)
+                        sock, _ = listener.accept()
+                        data = sock.recv(65536)
+                        self._handle(data)
+
+                def _handle(self, data):
+                    pass
+        """) == []
+
     def test_silent_on_own_resolved_future_and_nonblocking_work(self):
         # .result() on the callback's OWN argument is non-blocking (the
         # future is resolved by contract), including forwarded one call
@@ -878,6 +1231,24 @@ class TestC005:
                         self.retry.add(response)
         """) == []
 
+    def test_own_future_exemption_forwards_deeply(self):
+        # the resolved future rides two hand-offs; .result() on it is
+        # still exempt at depth
+        assert run_rule(RuleC005, """
+            class Scorer:
+                def submit(self, fut):
+                    fut.add_done_callback(self._on_done)
+
+                def _on_done(self, future):
+                    self._unwrap(future)
+
+                def _unwrap(self, fut):
+                    self._final(fut)
+
+                def _final(self, f):
+                    return f.result()
+        """) == []
+
     def test_silent_on_queue_ops_with_timeout_or_nowait(self):
         assert run_rule(RuleC005, """
             def wire(fut, queue):
@@ -886,7 +1257,327 @@ class TestC005:
         """) == []
 
 
-# -- lockwatch: runtime C001 --------------------------------------------------
+# -- C006: Eraser-style lockset race (replaces C003) --------------------------
+
+_C006_BUG = """
+    import threading
+
+    class P:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            while True:
+                self.count += 1
+
+        def submit(self, n):
+            self.count = n
+"""
+
+
+class TestC006:
+    def test_fires_on_unlocked_shared_counter(self):
+        hits = run_rule(RuleC006, _C006_BUG)
+        assert [f.rule_id for f in hits] == ["C006"]
+        assert "'count'" in hits[0].message
+        assert hits[0].symbol == "P.count"
+
+    def test_no_module_allowlist(self):
+        # C003 only looked at a hand-maintained module list; C006 fires
+        # anywhere in the package
+        hits = run_rule(
+            RuleC006, _C006_BUG, path="predictionio_tpu/tools/anytool.py"
+        )
+        assert [f.rule_id for f in hits] == ["C006"]
+
+    def test_silent_with_common_lock(self):
+        fixed = _C006_BUG.replace(
+            "            while True:\n                self.count += 1",
+            "            while True:\n                with self._lock:\n"
+            "                    self.count += 1",
+        ).replace(
+            "        def submit(self, n):\n            self.count = n",
+            "        def submit(self, n):\n            with self._lock:\n"
+            "                self.count = n",
+        )
+        assert run_rule(RuleC006, fixed) == []
+
+    def test_write_vs_unlocked_read_fires(self):
+        # the C003->C006 migration's deliberate behavior change: a READ
+        # against a concurrent writer races too (stale read /
+        # check-then-act); C003 required mutation on both sides
+        read_race = _C006_BUG.replace(
+            "        def submit(self, n):\n            self.count = n",
+            "        def submit(self, n):\n            return self.count",
+        )
+        hits = run_rule(RuleC006, read_race)
+        assert [f.rule_id for f in hits] == ["C006"]
+        assert "read under role" in hits[0].message
+
+    def test_fires_through_helper_call(self):
+        helper = """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self._bump()
+
+                def _bump(self):
+                    self.count += 1
+
+                def submit(self, n):
+                    self.count = n
+        """
+        hits = run_rule(RuleC006, helper)
+        assert [f.rule_id for f in hits] == ["C006"]
+
+    def test_disjoint_locksets_still_race(self):
+        # each side holds A lock -- just not the SAME lock: the exact
+        # Eraser shape a common-lock check without sets would miss
+        hits = run_rule(RuleC006, """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self.state = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._a:
+                        self.state += 1
+
+                def submit(self, n):
+                    with self._b:
+                        self.state = n
+        """)
+        assert [f.rule_id for f in hits] == ["C006"]
+        assert "no lock common" in hits[0].message
+
+    def test_lock_joined_over_call_path_silences(self):
+        # the lock is held by the CALLER of the mutating helper on every
+        # role's path: phase 1 could not see this, phase 2 must
+        assert run_rule(RuleC006, """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._lock:
+                        self._bump()
+
+                def _bump(self):
+                    self.count += 1
+
+                def submit(self, n):
+                    with self._lock:
+                        self._bump()
+        """) == []
+
+    def test_cross_module_thread_target_counts(self):
+        # the Thread(target=...) lives in ANOTHER module: C003's lexical
+        # in-class scan missed exactly this
+        index = build_index(
+            """
+            class Loop:
+                def run(self):
+                    self.cycles = self.cycles + 1
+
+                def status(self):
+                    return self.cycles
+            """,
+            """
+            import threading
+
+            from predictionio_tpu.pkg.mod0 import Loop
+
+            def launch():
+                loop = Loop()
+                t = threading.Thread(target=loop.run)
+                t.start()
+                return loop
+            """,
+        )
+        hits = list(RuleC006().check_package(index))
+        assert [f.symbol for f in hits] == ["Loop.cycles"]
+
+    def test_silent_when_single_role(self):
+        # background thread is the only mutator AND the only reader
+        assert run_rule(RuleC006, """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self.count = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self.count += 1
+                    self._log()
+
+                def _log(self):
+                    print(self.count)
+        """) == []
+
+    def test_init_and_lifecycle_writes_are_happens_before(self):
+        # the procserver start() shape: a thread-constructing method
+        # writes setup state before the spawn; only __init__/lifecycle
+        # writes exist, so no finding
+        assert run_rule(RuleC006, """
+            import threading
+
+            class Bridge:
+                def __init__(self):
+                    self.port = None
+
+                def start(self):
+                    self.port = 7
+                    self.workers = [1, 2]
+                    t = threading.Thread(target=self._consume)
+                    t.start()
+
+                def _consume(self):
+                    return self.port, self.workers
+        """) == []
+
+    def test_submit_gate_shape_is_the_negative(self):
+        # the data/ingest.py fix shape: the stop flag flips under the
+        # same gate lock submit checks it under -- common lock, silent
+        assert run_rule(RuleC006, """
+            import threading
+
+            class Pipeline:
+                def __init__(self):
+                    self._gate = threading.Lock()
+                    self._stopping = False
+                    self._thread = threading.Thread(target=self._writer)
+
+                def _writer(self):
+                    with self._gate:
+                        if self._stopping:
+                            return
+
+                def submit(self, item):
+                    with self._gate:
+                        if self._stopping:
+                            raise RuntimeError("stopping")
+
+                def stop(self):
+                    with self._gate:
+                        self._stopping = True
+        """) == []
+
+    def test_dead_flag_protocol_shape_is_the_negative(self):
+        # the serving/procserver.py fix shape: every access to the
+        # worker's dead flag happens under its cmp_lock (annotated
+        # receiver type resolves the cross-class lock identity)
+        assert run_rule(RuleC006, """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.cmp_lock = threading.Lock()
+                    self.dead = False
+
+            class Bridge:
+                def __init__(self):
+                    self._thread = threading.Thread(target=self._supervise)
+
+                def _supervise(self):
+                    w = Worker()
+                    self._retire(w)
+
+                def _retire(self, w: Worker):
+                    with w.cmp_lock:
+                        w.dead = True
+
+                def deliver(self, w: Worker, payload):
+                    with w.cmp_lock:
+                        if w.dead:
+                            return
+        """) == []
+
+    def test_thread_confined_local_object_skipped(self):
+        # the _ColumnSpill shape: built, used, and closed inside one
+        # call -- its fields cannot be shared
+        assert run_rule(RuleC006, """
+            import threading
+
+            class Spill:
+                def __init__(self):
+                    self.rows = 0
+
+                def add(self, n):
+                    self.rows += n
+
+            class Builder:
+                def __init__(self):
+                    self._thread = threading.Thread(target=self._build)
+
+                def _build(self):
+                    spill = Spill()
+                    spill.add(3)
+
+                def build_now(self):
+                    spill = Spill()
+                    spill.add(5)
+        """) == []
+
+    def test_main_plus_request_without_threads_is_silent(self):
+        # a tool class driven from __main__ with public methods: one
+        # thread in reality, no finding
+        assert run_rule(RuleC006, """
+            class Tool:
+                def step(self):
+                    self.n = getattr(self, "n", 0) + 1
+
+                def report(self):
+                    return self.n
+
+            def main():
+                t = Tool()
+                t.step()
+                t.report()
+
+            if __name__ == "__main__":
+                main()
+        """) == []
+
+    def test_finding_names_lock_sites_for_runtime_witness(self):
+        hits = run_rule(RuleC006, """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self.state = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._a:
+                        self.state += 1
+
+                def submit(self, n):
+                    self.state = n
+        """)
+        assert len(hits) == 1
+        assert "lockwatch" in hits[0].message
+        assert "predictionio_tpu.pkg.mod:" in hits[0].message
+
+
+# -- lockwatch: runtime C001 + the C006 witness -------------------------------
 
 class TestLockwatch:
     def test_seeded_inversion_across_two_threads_detected(self):
@@ -925,6 +1616,32 @@ class TestLockwatch:
         assert watch.inversions == []
         assert ("mod.py:20", "mod.py:21") in watch.edges
 
+    def test_held_locksets_recorded_per_acquisition(self):
+        # the C006 satellite: every acquisition records what was HELD
+        watch = lockwatch.LockWatch()
+        a = watch.wrap(threading.Lock(), "mod.py:30")
+        b = watch.wrap(threading.Lock(), "mod.py:31")
+        with a:
+            with b:
+                pass
+        with b:
+            pass
+        assert watch.held_at["mod.py:30"] == {frozenset()}
+        assert watch.held_at["mod.py:31"] == {
+            frozenset({"mod.py:30"}), frozenset(),
+        }
+
+    def test_runtime_witness_renders_evidence_and_absence(self):
+        watch = lockwatch.LockWatch()
+        a = watch.wrap(threading.Lock(), "pkg.mod:30")
+        b = watch.wrap(threading.Lock(), "pkg.mod:31")
+        with a:
+            with b:
+                pass
+        text = watch.runtime_witness(["pkg.mod:31", "pkg.other:99"])
+        assert "pkg.mod:31: acquired holding {pkg.mod:30}" in text
+        assert "pkg.other:99: never acquired under lockwatch" in text
+
     def test_install_wraps_package_locks_only(self):
         import queue
 
@@ -942,6 +1659,64 @@ class TestLockwatch:
         finally:
             if not was_installed:
                 lockwatch.uninstall()
+
+
+# -- the docstring-driven catalog ---------------------------------------------
+
+class TestCatalog:
+    def test_explain_prints_docstring_entry(self, capsys):
+        from predictionio_tpu.analysis.engine import run_cli
+
+        assert run_cli(["--explain", "c006"]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("C006 (error)")
+        assert "Eraser-style" in out and "Incident" in out
+
+    def test_explain_unknown_rule_errors(self, capsys):
+        from predictionio_tpu.analysis.engine import run_cli
+
+        assert run_cli(["--explain", "C099"]) == 2
+        assert "unknown rule" in capsys.readouterr().out
+
+    def test_every_rule_has_an_incident_entry(self):
+        from predictionio_tpu.analysis import all_rules
+        from predictionio_tpu.analysis.engine import _split_doc
+
+        for rule in all_rules():
+            flags, incident = _split_doc(rule)
+            assert flags, rule.rule_id
+            assert incident.startswith("Incident"), (
+                f"{rule.rule_id} docstring needs an 'Incident' paragraph "
+                "(it IS the docs table and --explain output)"
+            )
+
+    def test_update_docs_rejects_missing_markers(self, tmp_path, monkeypatch):
+        # a family whose markers vanished must error, not report success
+        # with that table silently stale
+        from predictionio_tpu.analysis import engine
+
+        partial = tmp_path / "docs.md"
+        partial.write_text(
+            engine.DOCS_TABLE_BEGIN.format(family="J") + "\n"
+            + engine.DOCS_TABLE_END.format(family="J") + "\n"
+        )
+        with pytest.raises(ValueError, match="C"):
+            engine.update_docs(str(partial))
+
+    def test_docs_rule_tables_in_sync_with_docstrings(self):
+        # the no-drift contract: the committed docs tables equal what
+        # the docstrings generate (regenerate: pio check --update-docs)
+        from predictionio_tpu.analysis.engine import (
+            default_docs_path,
+            render_rule_table,
+        )
+
+        with open(default_docs_path(), encoding="utf-8") as f:
+            docs = f.read()
+        for family in ("J", "C"):
+            assert render_rule_table(family) in docs, (
+                f"{family}-series table stale: run pio check --update-docs"
+            )
 
 
 # -- baseline + repo gate -----------------------------------------------------
@@ -988,18 +1763,33 @@ class TestBaseline:
 def test_repo_wide_zero_unsuppressed_findings():
     """THE tier-1 gate: every rule over the whole package, committed
     baseline applied, zero unsuppressed findings, no stale suppressions --
-    and the sweep stays inside the 2-core time budget."""
+    and the sweep stays inside the 2-core time budget. C006 findings are
+    annotated with lockwatch's runtime witness (what locks tier-1
+    actually held at the sites the static race names)."""
     t0 = time.monotonic()
     findings = check_paths()
     elapsed = time.monotonic() - t0
     unsuppressed, _, stale = apply_baseline(findings, load_baseline())
-    assert unsuppressed == [], "\n".join(f.render() for f in unsuppressed)
+    if unsuppressed:
+        import re
+
+        lines = []
+        for f in unsuppressed:
+            lines.append(f.render())
+            if f.rule_id == "C006":
+                sites = re.findall(r"[\w.]+:\d+", f.message)
+                sites = [s for s in sites if "." in s.split(":")[0]]
+                lines.append(
+                    "  runtime witness: "
+                    + lockwatch.global_watch().runtime_witness(sites)
+                )
+        raise AssertionError("\n".join(lines))
     assert stale == [], f"stale baseline entries: {stale}"
-    # budget raised 10s -> 15s in PR 8: the package grew (obs/, serving/)
-    # and C004 joined the sweep; a full run measures ~5s solo on the
-    # 2-core box, and the old budget left too little margin against
-    # co-tenant noise (observed 10.6s purely from box contention)
-    assert elapsed < 15.0, f"pio check took {elapsed:.1f}s (budget 15s)"
+    # phase-2 budget back to the ISSUE's 10 s: parsing is parallel and
+    # the package index is built once and shared; measured ~3.7 s solo
+    # on the 2-core box (PR 8 had raised it to 15 s for contention --
+    # the rebuilt sweep wins that margin back)
+    assert elapsed < 10.0, f"pio check took {elapsed:.1f}s (budget 10s)"
 
 
 def test_cli_check_json(capsys):
@@ -1026,9 +1816,9 @@ def test_update_baseline_scoped_run_preserves_out_of_scope_entries(tmp_path):
     scratch = tmp_path / "baseline.json"
     shutil.copy(default_baseline_path(), scratch)
     before = load_baseline(str(scratch))
-    # workflow/ has no findings and no baseline entries: nothing in scope
+    # controller/ has no findings and no baseline entries: nothing in scope
     rc = run_cli([
-        "predictionio_tpu/workflow", "--update-baseline",
+        "predictionio_tpu/controller", "--update-baseline",
         "--baseline", str(scratch),
     ])
     assert rc == 0
@@ -1037,6 +1827,40 @@ def test_update_baseline_scoped_run_preserves_out_of_scope_entries(tmp_path):
     rc = run_cli(["--rules", "J001", "--update-baseline", "--baseline", str(scratch)])
     assert rc == 0
     assert load_baseline(str(scratch)) == before
+
+
+def test_changed_scope_reports_only_changed_files(tmp_path, capsys, monkeypatch):
+    """--changed narrows the REPORT to git-touched files while the
+    analysis still sees the whole package, and out-of-scope baseline
+    entries never go stale (the PR 5 path-scoped semantics)."""
+    from predictionio_tpu.analysis import engine
+
+    monkeypatch.setattr(
+        engine, "changed_files",
+        lambda: ["predictionio_tpu/workflow/microbatch.py"],
+    )
+    rc = engine.run_cli(["--changed", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["findings"] == [] and doc["stale_baseline"] == []
+    # every baseline entry lives outside the changed set -> none were in
+    # scope, so the suppressed list for this run is empty, NOT stale
+    assert doc["suppressed"] == []
+
+
+def test_changed_rejects_explicit_paths(capsys):
+    from predictionio_tpu.analysis.engine import run_cli
+
+    assert run_cli(["--changed", "predictionio_tpu/data"]) == 2
+    assert "mutually exclusive" in capsys.readouterr().out
+
+
+def test_changed_files_runs_git():
+    from predictionio_tpu.analysis.engine import changed_files
+
+    files = changed_files()   # the repo IS a git checkout
+    assert isinstance(files, list)
+    assert all(f.endswith(".py") for f in files)
 
 
 def test_cli_rejects_bad_paths_and_none_update(capsys):
