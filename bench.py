@@ -343,6 +343,10 @@ def secondary_main(result_path: str) -> None:
         tmp = result_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(results, f)
+            f.flush()
+            # hours of bench phases feed this file; a crash must not
+            # tear the trend line (pio check R003)
+            os.fsync(f.fileno())
         os.replace(tmp, result_path)
 
     def phase(name: str, fn) -> None:
@@ -693,13 +697,15 @@ def secondary_main(result_path: str) -> None:
         line that shows when the deepening analysis starts eating that
         budget. No JAX, identical on CPU and TPU children."""
         from predictionio_tpu.analysis.engine import (
+            all_rules,
             apply_baseline,
             check_paths,
             load_baseline,
         )
 
+        timings: dict = {}
         t0 = time.perf_counter()
-        findings = check_paths()
+        findings = check_paths(timings=timings)
         runtime_s = time.perf_counter() - t0
         unsuppressed, suppressed, stale = apply_baseline(
             findings, load_baseline()
@@ -710,6 +716,17 @@ def secondary_main(result_path: str) -> None:
         return {
             "analysis_findings_total": len(unsuppressed),
             "analysis_runtime_seconds": round(runtime_s, 3),
+            # per-family attribution (J = module walks, C = the shared
+            # package index is charged to "index" + the C DFS passes,
+            # R = flowgraph build + the four leak rules): the trend line
+            # that shows WHICH deepening layer starts eating the budget
+            "analysis_runtime_seconds_by_family": {
+                fam: round(s, 3)
+                for fam, s in sorted(timings.get("families", {}).items())
+            },
+            "analysis_parse_seconds": round(timings.get("parse", 0.0), 3),
+            "analysis_index_seconds": round(timings.get("index", 0.0), 3),
+            "analysis_rules_total": len(all_rules()),
             "suppressed": len(suppressed),
             "stale_baseline": len(stale),
             "findings_by_rule": by_rule,
@@ -919,6 +936,8 @@ def child_main(mode: str, result_path: str) -> None:
     tmp = result_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, result_path)
 
 

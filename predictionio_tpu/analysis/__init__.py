@@ -17,13 +17,23 @@ Rule families (catalog with incidents: ``docs/static_analysis.md``;
   blocking calls reachable from flusher callbacks / event loops (C005),
   and the Eraser-style lockset race detector (C006, which replaced
   C003's allowlisted per-module walk).
+- **R-series** (``rules_resources``): exception-path resource-lifecycle
+  analysis on the phase-3 flowgraph layer (``flowgraph``): per-function
+  CFGs with explicit exception edges and a must-release obligation
+  domain, credited interprocedurally through the call graph. Permits/
+  locks/fds leaked on exception paths (R001), spans neither finished
+  nor detached (R002), tmp+fsync+rename / checkpoint-ordering
+  durability violations (R003), obligations that die with no owner
+  (R004).
 
 ``analysis/baseline.json`` suppresses accepted findings (with mandatory
 justifications); the tier-1 gate in ``tests/test_analysis.py`` asserts
 zero unsuppressed findings over the package. ``analysis/lockwatch.py``
-is the runtime companion: it validates C001 against actual acquisition
-orders under pytest and records the held lockset at every acquisition so
-C006 findings can cite runtime evidence.
+and ``analysis/leakwatch.py`` are the runtime companions: lockwatch
+validates C001 against actual acquisition orders under pytest and
+records held locksets for C006's evidence; leakwatch watches span
+lifecycles and package semaphore balances so an R-series leak a test
+provokes fails that test with the site named.
 """
 
 from predictionio_tpu.analysis.engine import (  # noqa: F401

@@ -112,6 +112,12 @@ class ModuleInfo:
     imports: dict = field(default_factory=dict)
     #: statements under ``if __name__ == "__main__":`` (subprocess entry)
     main_body: list = field(default_factory=list)
+    #: Import/ImportFrom nodes, collected during the ONE indexing visit
+    #: (function-level lazy imports included) so no later pass re-walks
+    #: the module tree
+    import_nodes: list = field(default_factory=list)
+    #: Assign-from-Call nodes (the lock/semaphore-constructor candidates)
+    call_assigns: list = field(default_factory=list)
 
     @property
     def path(self) -> str:
@@ -154,6 +160,11 @@ class CallGraph:
         #: (fkey, param) -> set[FunctionInfo]: higher-order bindings
         self.param_bindings: dict[tuple, set] = {}
         self._local_env_cache: dict[tuple, dict] = {}
+        #: id(fn node) -> flattened body-node list; every layer built on
+        #: the graph (edges, roles, locksets fast path, the R-series
+        #: flowgraphs) re-reads this instead of re-walking the AST --
+        #: the body walk was the single hottest loop in the sweep
+        self._body_cache: dict[int, list] = {}
         for ctx in contexts:
             self._index_module(ctx)
         self._index_imports()
@@ -165,19 +176,38 @@ class CallGraph:
         mod = ModuleInfo(ctx=ctx, dotted=module_dotted(ctx.path))
         self.modules[mod.dotted] = mod
         self.by_path[mod.path] = mod
+        # nodes outside any function body (module level, decorators,
+        # argument defaults) land here: traversed for indexing, read by
+        # nobody -- the fill below is what makes body_nodes() free
+        dead: list = []
+
+        def enter_function(child, fq, owner):
+            """Recurse into a def/lambda, filling its body-node cache
+            inline: body statements (and their subtrees) go to the
+            function's list, decorators/args are indexed but -- like
+            ``_body_walk`` -- belong to no body."""
+            fbody: list = []
+            self._body_cache[id(child)] = fbody
+            stmts = child.body if isinstance(child.body, list) else [child.body]
+            body_ids = {id(s) for s in stmts}
+            for sub in ast.iter_child_nodes(child):
+                visit([sub], fq, None, owner,
+                      fbody if id(sub) in body_ids else dead)
 
         def visit(
-            node: ast.AST, qual: str,
+            children, qual: str,
             parent_cls: ClassInfo | None,   # class this is a DIRECT child of
             encl_cls: ClassInfo | None,     # innermost lexically-enclosing class
+            body: list,                     # innermost function's node list
         ):
-            for child in ast.iter_child_nodes(node):
+            for child in children:
                 if isinstance(child, ast.ClassDef):
                     cq = f"{qual}.{child.name}" if qual else child.name
                     cinfo = ClassInfo(mod.path, cq, child, module=mod)
                     mod.classes[cq] = cinfo
                     self.classes[cinfo.key] = cinfo
-                    visit(child, cq, cinfo, cinfo)
+                    body.append(child)
+                    visit(ast.iter_child_nodes(child), cq, cinfo, cinfo, body)
                 elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     fq = f"{qual}.{child.name}" if qual else child.name
                     owner = parent_cls or encl_cls
@@ -195,7 +225,7 @@ class CallGraph:
                         parent_cls.methods[child.name] = info
                     elif not qual:
                         mod.top[child.name] = info
-                    visit(child, fq, None, owner)
+                    enter_function(child, fq, owner)
                 elif isinstance(child, ast.Lambda):
                     fq = f"{qual}.<lambda:{child.lineno}>" if qual else (
                         f"<lambda:{child.lineno}>"
@@ -208,21 +238,31 @@ class CallGraph:
                     )
                     mod.funcs[fq] = info
                     self.functions[info.key] = info
-                    visit(child, fq, None, owner)
+                    enter_function(child, fq, owner)
                 else:
-                    if (
+                    if isinstance(child, (ast.Import, ast.ImportFrom)):
+                        mod.import_nodes.append(child)
+                    elif isinstance(child, ast.Assign) and isinstance(
+                        child.value, ast.Call
+                    ):
+                        mod.call_assigns.append(child)
+                    elif (
                         isinstance(child, ast.If)
                         and qual == ""
                         and _is_main_guard(child.test)
                     ):
                         mod.main_body.extend(child.body)
-                    visit(child, qual, parent_cls, encl_cls)
+                    body.append(child)
+                    visit(
+                        ast.iter_child_nodes(child), qual, parent_cls,
+                        encl_cls, body,
+                    )
 
-        visit(ctx.tree, "", None, None)
+        visit(ast.iter_child_nodes(ctx.tree), "", None, None, dead)
 
     def _index_imports(self) -> None:
         for mod in self.modules.values():
-            for node in ast.walk(mod.ctx.tree):
+            for node in mod.import_nodes:
                 if isinstance(node, ast.Import):
                     for alias in node.names:
                         if alias.name.startswith(PACKAGE):
@@ -266,7 +306,7 @@ class CallGraph:
         for cinfo in self.classes.values():
             for meth in cinfo.methods.values():
                 params = set(meth.params())
-                for node in _body_walk(meth.node):
+                for node in self.body_nodes(meth.node):
                     if not isinstance(node, ast.Assign):
                         continue
                     for t in node.targets:
@@ -349,7 +389,7 @@ class CallGraph:
                         hit = self._resolve_class_expr(fi, ann)
                         if hit is not None:
                             env[p.arg] = ("type", hit)
-        for node in _body_walk(fi.node):
+        for node in self.body_nodes(fi.node):
             if not isinstance(node, ast.Assign):
                 continue
             names = [
@@ -536,7 +576,7 @@ class CallGraph:
         for fi in list(self.functions.values()):
             params = set(fi.params())
             sites: list[CallSite] = []
-            for node in _body_walk(fi.node):
+            for node in self.body_nodes(fi.node):
                 if not isinstance(node, ast.Call):
                     continue
                 targets = self.resolve_call(fi, node)
@@ -628,6 +668,22 @@ class CallGraph:
         return False
 
     # -- convenience --------------------------------------------------------
+    def body_nodes(self, fn: ast.AST) -> list:
+        """The function's body nodes, excluding nested defs/lambdas and
+        their subtrees (those are their own call-graph nodes). Filled
+        inline during indexing; the fallback (un-indexed nodes, e.g. a
+        module tree) filters ``_body_walk`` to the same contract -- the
+        raw walk also yields direct-child def statements themselves."""
+        cached = self._body_cache.get(id(fn))
+        if cached is None:
+            cached = self._body_cache[id(fn)] = [
+                n for n in _body_walk(fn)
+                if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                )
+            ]
+        return cached
+
     def callees(self, fkey: tuple) -> list:
         return self.callsites.get(fkey, [])
 

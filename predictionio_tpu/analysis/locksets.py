@@ -33,7 +33,7 @@ import ast
 from dataclasses import dataclass, field
 
 from predictionio_tpu.analysis.astutil import call_name, dotted, keyword
-from predictionio_tpu.analysis.callgraph import CallGraph, FunctionInfo, _body_walk
+from predictionio_tpu.analysis.callgraph import CallGraph, FunctionInfo
 
 _LOCK_CTORS = {
     "threading.Lock", "threading.RLock", "threading.Condition",
@@ -134,12 +134,8 @@ class LockModel:
     # -- lock inventory -----------------------------------------------------
     def _collect_locks(self) -> None:
         for mod in self.graph.modules.values():
-            for node in ast.walk(mod.ctx.tree):
-                if not (
-                    isinstance(node, ast.Assign)
-                    and isinstance(node.value, ast.Call)
-                    and call_name(node.value) in _LOCK_CTORS
-                ):
+            for node in mod.call_assigns:
+                if call_name(node.value) not in _LOCK_CTORS:
                     continue
                 cls = mod.ctx.symbol_for(node)
                 for t in node.targets:
@@ -216,6 +212,20 @@ class LockModel:
             cinfo = self.graph.classes.get((fi.path, fi.cls))
             if cinfo is not None:
                 method_names = set(cinfo.methods)
+        nodes = self.graph.body_nodes(fi.node)
+        if not any(
+            isinstance(n, (ast.With, ast.AsyncWith))
+            or (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "acquire"
+            )
+            for n in nodes
+        ):
+            # lock-free function (the overwhelming majority): every held
+            # set is empty, so the facts fall straight out of the cached
+            # flat body list -- no region recursion
+            return self._walk_flat(fi, facts, method_names, nodes)
 
         def visit(node: ast.AST, held: tuple) -> None:
             if isinstance(node, (ast.With, ast.AsyncWith)):
@@ -309,6 +319,65 @@ class LockModel:
         body = fi.node.body if isinstance(fi.node.body, list) else [fi.node.body]
         for stmt in body:
             visit(stmt, ())
+        return facts
+
+    _EMPTY = frozenset()
+
+    def _walk_flat(
+        self, fi: FunctionInfo, facts: FuncFacts, method_names: set, nodes
+    ) -> FuncFacts:
+        """The no-locks fast path: identical facts to the region walk,
+        with every held set the empty frozenset."""
+        held = self._EMPTY
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                reason = blocking_reason(node)
+                if reason is not None:
+                    facts.blocking.append((reason, held, node.lineno, node))
+                facts.calls.append((node, held, node.lineno))
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS
+                ):
+                    recv = dotted(node.func.value) or ""
+                    if recv.startswith("self.") and recv.count(".") == 1:
+                        rtype = self.graph.instance_type(fi, node.func.value)
+                        if rtype is None or node.func.attr not in rtype.methods:
+                            facts.accesses.append(Access(
+                                recv[len("self."):], "write",
+                                node.lineno, held,
+                            ))
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    d = dotted(base)
+                    if d and d.startswith("self.") and d.count(".") == 1:
+                        facts.accesses.append(Access(
+                            d[len("self."):], "write", node.lineno, held,
+                        ))
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    d = dotted(t)
+                    if d and d.startswith("self.") and d.count(".") == 1:
+                        facts.accesses.append(Access(
+                            d[len("self."):], "write", node.lineno, held,
+                        ))
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr not in method_names
+            ):
+                facts.accesses.append(Access(
+                    node.attr, "read", node.lineno, held
+                ))
         return facts
 
     # -- interprocedural contexts -------------------------------------------

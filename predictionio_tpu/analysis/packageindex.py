@@ -28,6 +28,9 @@ class PackageIndex:
     graph: CallGraph
     roles: RoleInference
     locks: LockModel
+    #: lazily-built phase-3 layer (exception-edge resource dataflow);
+    #: J/C-only runs never pay for it
+    _resources: object = None
 
     @classmethod
     def build(cls, contexts: list) -> "PackageIndex":
@@ -38,6 +41,17 @@ class PackageIndex:
             roles=RoleInference(graph),
             locks=LockModel(graph),
         )
+
+    def resources(self):
+        """The shared :class:`~predictionio_tpu.analysis.flowgraph.
+        ResourceFlow`: per-function flowgraphs + obligation summaries,
+        built ONCE per index and cached alongside it (every R rule
+        reads the same build)."""
+        if self._resources is None:
+            from predictionio_tpu.analysis.flowgraph import ResourceFlow
+
+            self._resources = ResourceFlow(self)
+        return self._resources
 
 
 class PackageRule:

@@ -26,7 +26,6 @@ import ast
 from typing import Iterator
 
 from predictionio_tpu.analysis.astutil import call_name, dotted
-from predictionio_tpu.analysis.callgraph import _body_walk
 from predictionio_tpu.analysis.engine import Finding, ModuleContext
 from predictionio_tpu.analysis.locksets import blocking_reason
 from predictionio_tpu.analysis.packageindex import PackageIndex, PackageRule
@@ -541,7 +540,7 @@ class RuleC006(PackageRule):
                 if name in ("__init__", "__enter__"):
                     out.add(meth.key)
                     continue
-                for node in _body_walk(meth.node):
+                for node in index.graph.body_nodes(meth.node):
                     if isinstance(node, ast.Call):
                         cn = call_name(node)
                         if cn.endswith(("Thread", "Timer")) and cn not in (
@@ -651,7 +650,7 @@ class RuleC006(PackageRule):
             constructed.update(local_types)
             if not local_types:
                 continue
-            for node in _body_walk(fi.node):
+            for node in index.graph.body_nodes(fi.node):
                 # returning or passing the instance publishes it
                 if isinstance(node, ast.Return) and node.value is not None:
                     t = graph.instance_type(fi, node.value)

@@ -41,11 +41,13 @@ def _is_shim(ctx: ModuleContext) -> bool:
 
 
 def _jit_index(ctx: ModuleContext) -> "_JitIndex":
-    """One _JitIndex per module, shared by J002/J003/J004."""
-    cached = ctx.symbols.get("__jit_index__")
+    """One _JitIndex per module, shared by J002/J003/J004. Cached on the
+    context object itself (the symbols map builds lazily and must stay
+    pure node->qualname)."""
+    cached = getattr(ctx, "_jit_index_cache", None)
     if cached is None:
         cached = _JitIndex(ctx)
-        ctx.symbols["__jit_index__"] = cached
+        ctx._jit_index_cache = cached
     return cached
 
 

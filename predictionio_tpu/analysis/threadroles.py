@@ -39,7 +39,7 @@ import ast
 from dataclasses import dataclass
 
 from predictionio_tpu.analysis.astutil import call_name, keyword
-from predictionio_tpu.analysis.callgraph import CallGraph, _body_walk
+from predictionio_tpu.analysis.callgraph import CallGraph
 
 #: role kinds that denote a distinct concurrent execution context (used
 #: by C006; ``eventloop`` is excluded -- see module docstring)
@@ -73,7 +73,7 @@ class RoleInference:
     # -- seeds --------------------------------------------------------------
     def _seed(self) -> None:
         for fi in self.graph.functions.values():
-            for node in _body_walk(fi.node):
+            for node in self.graph.body_nodes(fi.node):
                 if not isinstance(node, ast.Call):
                     continue
                 name = call_name(node)
@@ -111,7 +111,7 @@ class RoleInference:
                     and node.args
                 ):
                     self._add_seed(fi, "callback", site, node.args[0])
-            if self._is_select_loop(fi.node):
+            if self._is_select_loop(fi):
                 role = Role("eventloop", f"{fi.path}:{fi.node.lineno}")
                 self._seed_entries.append((role, fi.key))
         for mod in self.graph.modules.values():
@@ -129,11 +129,10 @@ class RoleInference:
         for target in self.graph.resolve_callable(fi, expr):
             self._seed_entries.append((Role(kind, site), target.key))
 
-    @staticmethod
-    def _is_select_loop(fn: ast.AST) -> bool:
+    def _is_select_loop(self, fi) -> bool:
         """A while-loop body that polls ``*.select(...)``: the
         single-thread event-loop shape (frontend serve, ring consumer)."""
-        for node in _body_walk(fn):
+        for node in self.graph.body_nodes(fi.node):
             if not isinstance(node, ast.While):
                 continue
             for inner in ast.walk(node):

@@ -75,10 +75,16 @@ _FORWARD_BUCKETS = (
 def reuseport_listener(host: str, port: int, backlog: int = 128) -> socket.socket:
     """A listening socket in the port's ``SO_REUSEPORT`` group."""
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
-    sock.bind((host, port))
-    sock.listen(backlog)
-    sock.setblocking(False)
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        sock.bind((host, port))
+        sock.listen(backlog)
+        sock.setblocking(False)
+    except BaseException:
+        # a bind/listen failure (port stolen between reserve and spawn)
+        # must not leak the descriptor into the worker's retry loop
+        sock.close()
+        raise
     return sock
 
 

@@ -618,7 +618,16 @@ class ScorerBridge:
                                 if self._stopping:
                                     return
                             break
-                        msg = w.ring.requests.pop()
+                        try:
+                            msg = w.ring.requests.pop()
+                        except BaseException:
+                            # the supervisor can close a retired worker's
+                            # ring between the acquire and this read; the
+                            # permit must ride every exit out of the pop,
+                            # or each lost race permanently shrinks
+                            # max_inflight (pio check R001)
+                            self._inflight.release()
+                            raise
                         if msg is None:
                             self._inflight.release()
                             break

@@ -310,14 +310,20 @@ class RingFile:
             f.truncate(size)
         os.replace(tmp, path)
         f = open(path, "r+b")
-        mm = mmap.mmap(f.fileno(), size)
-        struct.pack_into("<I", mm, _OFF_MAGIC, MAGIC)
-        struct.pack_into("<I", mm, _OFF_VERSION, VERSION)
-        struct.pack_into("<Q", mm, _OFF_GENERATION, generation)
-        struct.pack_into("<I", mm, _OFF_STATE, STATE_INIT)
-        struct.pack_into("<I", mm, HEADER_BYTES - 8, slots)
-        struct.pack_into("<I", mm, HEADER_BYTES - 4, slot_bytes)
-        return cls(path, mm, f)
+        try:
+            mm = mmap.mmap(f.fileno(), size)
+            struct.pack_into("<I", mm, _OFF_MAGIC, MAGIC)
+            struct.pack_into("<I", mm, _OFF_VERSION, VERSION)
+            struct.pack_into("<Q", mm, _OFF_GENERATION, generation)
+            struct.pack_into("<I", mm, _OFF_STATE, STATE_INIT)
+            struct.pack_into("<I", mm, HEADER_BYTES - 8, slots)
+            struct.pack_into("<I", mm, HEADER_BYTES - 4, slot_bytes)
+            return cls(path, mm, f)
+        except BaseException:
+            # a failed map/header init must not strand the descriptor on
+            # the supervisor's respawn loop (pio check R001)
+            f.close()
+            raise
 
     @classmethod
     def attach(cls, path: str) -> "RingFile":

@@ -191,6 +191,11 @@ class CheckpointManager:
         tmp = self._meta_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f)
+            f.flush()
+            # the sidecar gates whether a multi-hour train resumes or
+            # restarts; rename gives atomicity, only fsync gives the
+            # bytes durability (tmp+fsync+rename, pio check R003)
+            os.fsync(f.fileno())
         os.replace(tmp, self._meta_path)
 
     def read_meta(self) -> dict | None:

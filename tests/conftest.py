@@ -65,6 +65,60 @@ def _lockwatch_inversions(_lockwatch_session):
     )
 
 
+def _leakwatch_enabled() -> bool:
+    from predictionio_tpu.analysis import leakwatch
+
+    return leakwatch.enabled_default()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _leakwatch_session():
+    """Runtime validation of the static R001/R002 rules (``pio check``):
+    every Span and every predictionio_tpu-constructed Semaphore is
+    watched for the whole suite, so a span left unfinished or a permit
+    held past a test's end surfaces as a test failure.
+    ``PIO_LEAKWATCH=0`` opts out."""
+    if not _leakwatch_enabled():
+        yield
+        return
+    from predictionio_tpu.analysis import leakwatch
+
+    leakwatch.install()
+    yield
+    leakwatch.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _leakwatch_leaks(_leakwatch_session):
+    """Fail the test during which a span leaked or a permit went
+    unbalanced (after a short settle window: teardown may finish a
+    straggler span a few milliseconds after the test body returns)."""
+    if not _leakwatch_enabled():
+        yield
+        return
+    from predictionio_tpu.analysis import leakwatch
+
+    watch = leakwatch.global_watch()
+    spans_before = watch.span_snapshot()
+    debts_before = watch.permit_debts()
+    yield
+    leaked = leakwatch.settle(
+        lambda: watch.new_pending_spans(spans_before)
+    )
+    assert not leaked, "unfinished span(s) leaked by this test: " + ", ".join(
+        f"{s.op} (trace {s.trace_id})" for s in leaked
+    )
+    debts = leakwatch.settle(
+        lambda: leakwatch.LeakWatch.new_debts(
+            debts_before, watch.permit_debts()
+        )
+    )
+    assert not debts, (
+        "admission permit(s) held past the test's end: "
+        + ", ".join(f"{site}: +{n}" for site, n in sorted(debts.items()))
+    )
+
+
 @pytest.fixture()
 def storage_env(tmp_path, monkeypatch):
     """Point the storage registry at a fresh sqlite file per test."""
