@@ -718,8 +718,9 @@ def secondary_main(result_path: str) -> None:
             "analysis_runtime_seconds": round(runtime_s, 3),
             # per-family attribution (J = module walks, C = the shared
             # package index is charged to "index" + the C DFS passes,
-            # R = flowgraph build + the four leak rules): the trend line
-            # that shows WHICH deepening layer starts eating the budget
+            # R = flowgraph build + the four leak rules, S = meshflow
+            # build + the five sharding rules): the trend line that
+            # shows WHICH deepening layer starts eating the budget
             "analysis_runtime_seconds_by_family": {
                 fam: round(s, 3)
                 for fam, s in sorted(timings.get("families", {}).items())

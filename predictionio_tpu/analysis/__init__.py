@@ -25,6 +25,15 @@ Rule families (catalog with incidents: ``docs/static_analysis.md``;
   nor detached (R002), tmp+fsync+rename / checkpoint-ordering
   durability violations (R003), obligations that die with no owner
   (R004).
+- **S-series** (``rules_sharding``): sharding semantics on the phase-4
+  meshflow layer (``meshflow``): mesh/PartitionSpec/NamedSharding
+  construction sites, shard_map bindings, and collectives tracked as an
+  abstract domain over the call graph. Collectives over unbound axis
+  names (S001), specs placed on meshes lacking their axes (S002),
+  pallas_call opaque to GSPMD outside shard_map under a multi-axis mesh
+  (S003), read-after-donate (S004), global placement inside shard_map
+  bodies (S005). ``pio check --mesh-report`` renders the same layer as
+  the mesh/shard_map/spec site inventory.
 
 ``analysis/baseline.json`` suppresses accepted findings (with mandatory
 justifications); the tier-1 gate in ``tests/test_analysis.py`` asserts

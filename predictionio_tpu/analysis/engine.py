@@ -47,6 +47,10 @@ class Finding:
     #: structured witness call path ("path:qual:line" hops) -- rendered
     #: as SARIF codeFlows; interprocedural rules populate it
     witness: tuple = ()
+    #: (path, line, label) construction sites backing the finding (the
+    #: mesh/spec mint sites of the S rules) -- rendered as SARIF
+    #: relatedLocations
+    related: tuple = ()
 
     def key(self) -> tuple:
         return (self.rule_id, self.path, self.symbol)
@@ -118,8 +122,14 @@ def iter_py_files(root: str) -> Iterator[str]:
 
 def parse_module(path: str, root: str | None = None) -> ModuleContext | None:
     root = root or repo_root()
-    with open(path, "r", encoding="utf-8") as f:
-        source = f.read()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except OSError:
+        # a path that vanished between scoping and parsing (a deleted
+        # file in the --changed diff, a mid-run unlink) is skipped like
+        # a syntax error, never a crash
+        return None
     rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
     try:
         tree = ast.parse(source, filename=rel)
@@ -139,11 +149,13 @@ def all_rules() -> list:
         rules_concurrency,
         rules_jax,
         rules_resources,
+        rules_sharding,
     )
 
     return [
         cls() for cls in (
             rules_jax.RULES + rules_concurrency.RULES + rules_resources.RULES
+            + rules_sharding.RULES
         )
     ]
 
@@ -270,11 +282,18 @@ def _check_paths(paths, rules, module_scope, timings) -> list[Finding]:
 def changed_files() -> list[str]:
     """Repo-relative ``.py`` files the working tree has touched vs HEAD
     (staged, unstaged, and untracked) -- the ``pio check --changed``
-    pre-commit scope."""
+    pre-commit scope.
+
+    Deletions and renames resolve to SURVIVING paths only:
+    ``--diff-filter=d`` drops deleted entries at the git level (rename
+    sources included -- with rename detection off a rename is a
+    delete+add pair), and the existence filter below backstops any git
+    that still lists a path with no file behind it. Scoping a vanished
+    path would either crash the parse or silently report on nothing."""
     root = repo_root()
     out: set[str] = set()
     for cmd in (
-        ["git", "diff", "--name-only", "HEAD", "--"],
+        ["git", "diff", "--name-only", "--diff-filter=d", "HEAD", "--"],
         ["git", "ls-files", "--others", "--exclude-standard"],
     ):
         proc = subprocess.run(
@@ -439,6 +458,14 @@ def _sarif_result(f: Finding, suppressed: bool) -> dict:
                 "location": _sarif_location(hop_path, hop_line, label),
             })
         result["codeFlows"] = [{"threadFlows": [{"locations": flow_locs}]}]
+    if f.related:
+        # construction sites backing the finding (the S rules' mesh/spec
+        # mint sites) ride as relatedLocations so a CI annotator can link
+        # "where the mesh/spec came from" next to the violation
+        result["relatedLocations"] = [
+            _sarif_location(rpath, rline, label)
+            for rpath, rline, label in f.related
+        ]
     if suppressed:
         result["suppressions"] = [{"kind": "external"}]
     return result
@@ -547,6 +574,9 @@ _INCIDENT_RE = re.compile(r"\bIncident\b")
 DOCS_TABLE_BEGIN = "<!-- BEGIN GENERATED RULE TABLE: {family} (pio check --update-docs) -->"
 DOCS_TABLE_END = "<!-- END GENERATED RULE TABLE: {family} -->"
 
+#: every docstring-generated rule family, in docs order
+DOC_FAMILIES = ("J", "C", "R", "S")
+
 
 def _split_doc(rule) -> tuple[str, str]:
     """A rule docstring split into (what it flags, the incident it
@@ -623,7 +653,7 @@ def update_docs(path: str | None = None) -> list[str]:
     with open(path, "r", encoding="utf-8") as f:
         text = f.read()
     missing = [
-        family for family in ("J", "C", "R")
+        family for family in DOC_FAMILIES
         if DOCS_TABLE_BEGIN.format(family=family) not in text
         or DOCS_TABLE_END.format(family=family) not in text
     ]
@@ -634,7 +664,7 @@ def update_docs(path: str | None = None) -> list[str]:
             f"in {path}"
         )
     replaced = []
-    for family in ("J", "C", "R"):
+    for family in DOC_FAMILIES:
         begin = DOCS_TABLE_BEGIN.format(family=family)
         end = DOCS_TABLE_END.format(family=family)
         head, rest = text.split(begin, 1)
@@ -669,6 +699,13 @@ def add_check_arguments(parser) -> None:
         "--update-docs", action="store_true",
         help="regenerate the rule tables in docs/static_analysis.md "
         "from the rule docstrings",
+    )
+    parser.add_argument(
+        "--mesh-report", action="store_true",
+        help="emit the inventory of mesh/shard_map/PartitionSpec/"
+        "NamedSharding/sharded-jit construction sites (text or --format "
+        "json) instead of running the rules -- the MPMD executor-"
+        "extraction worklist",
     )
     parser.add_argument(
         "--rules", default=None,
@@ -743,6 +780,34 @@ def run_with_args(args) -> int:
         print(
             f"docs rule table(s) regenerated: {', '.join(replaced)}-series"
         )
+        return 0
+    if getattr(args, "mesh_report", False):
+        if args.format == "sarif":
+            print("Error: --mesh-report renders text or json, not sarif")
+            return 2
+        missing = [p for p in args.paths if not os.path.exists(p)]
+        if missing:
+            print(f"Error: no such file or directory: {', '.join(missing)}")
+            return 2
+        from predictionio_tpu.analysis.meshflow import (
+            MeshFlow,
+            render_mesh_report_json,
+            render_mesh_report_text,
+        )
+        from predictionio_tpu.analysis.packageindex import PackageIndex
+
+        root = repo_root()
+        files: list[str] = []
+        for p in args.paths or [package_root()]:
+            if os.path.isdir(p):
+                files.extend(iter_py_files(p))
+            else:
+                files.append(p)
+        flow = MeshFlow(PackageIndex.build(parse_files(files, root)))
+        if args.format == "json":
+            print(render_mesh_report_json(flow))
+        else:
+            print(render_mesh_report_text(flow))
         return 0
     if args.self_check:
         problems = self_check(

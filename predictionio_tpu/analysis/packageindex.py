@@ -31,6 +31,9 @@ class PackageIndex:
     #: lazily-built phase-3 layer (exception-edge resource dataflow);
     #: J/C-only runs never pay for it
     _resources: object = None
+    #: lazily-built sharding-facts layer (meshflow); non-S runs never
+    #: pay for it
+    _meshflow: object = None
 
     @classmethod
     def build(cls, contexts: list) -> "PackageIndex":
@@ -52,6 +55,17 @@ class PackageIndex:
 
             self._resources = ResourceFlow(self)
         return self._resources
+
+    def meshflow(self):
+        """The shared :class:`~predictionio_tpu.analysis.meshflow.
+        MeshFlow`: mesh/spec/collective sharding facts + contexts, built
+        ONCE per index and cached (every S rule and ``--mesh-report``
+        read the same build)."""
+        if self._meshflow is None:
+            from predictionio_tpu.analysis.meshflow import MeshFlow
+
+            self._meshflow = MeshFlow(self)
+        return self._meshflow
 
 
 class PackageRule:

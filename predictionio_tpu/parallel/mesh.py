@@ -39,7 +39,24 @@ def local_mesh(data: int | None = None, model: int = 1) -> Mesh:
     return Mesh(grid, ("data", "model"))
 
 
+def require_axes(mesh: Mesh, axes, what: str) -> None:
+    """Fail fast when a spec/collective axis name is not bound by this
+    mesh. The runtime twin of ``pio check``'s S001/S002: today every
+    mesh is ``local_mesh()``'s ``("data", "model")`` singleton, but the
+    MPMD slice directions mint per-engine meshes with their own axis
+    sets -- an eager ValueError naming both sides beats jax's late
+    unbound-axis-name error deep inside a trace."""
+    missing = [a for a in axes if a is not None and a not in mesh.axis_names]
+    if missing:
+        raise ValueError(
+            f"{what}: axis name(s) {missing} not bound by this mesh "
+            f"(axes={list(mesh.axis_names)}) -- build the spec from the "
+            f"mesh's own axis names or thread the intended mesh here"
+        )
+
+
 def row_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    require_axes(mesh, (axis,), "row_sharded")
     return NamedSharding(mesh, PartitionSpec(axis))
 
 
@@ -118,6 +135,7 @@ def seq_parallel_shard_map(body, mesh: Mesh, axis_name: str, check_vma: bool = T
     """
     from jax.sharding import PartitionSpec as P
 
+    require_axes(mesh, (axis_name,), "seq_parallel_shard_map")
     batch_axis = "data" if "data" in mesh.axis_names else None
     spec = P(batch_axis, axis_name, None, None)
     mspec = P(batch_axis, axis_name)
@@ -129,6 +147,7 @@ def seq_parallel_shard_map(body, mesh: Mesh, axis_name: str, check_vma: bool = T
 
 def shard_rows(mesh: Mesh, *arrays, axis: str = "data"):
     """Pad rows to the axis size and device_put sharded on the leading dim."""
+    require_axes(mesh, (axis,), "shard_rows")
     n_shards = mesh.shape[axis]
     out = []
     for arr in arrays:

@@ -46,6 +46,13 @@ from predictionio_tpu.analysis.rules_jax import (
     RuleJ005,
     RuleJ006,
 )
+from predictionio_tpu.analysis.rules_sharding import (
+    RuleS001,
+    RuleS002,
+    RuleS003,
+    RuleS004,
+    RuleS005,
+)
 from predictionio_tpu.analysis.threadroles import RoleInference
 
 
@@ -1719,7 +1726,10 @@ class TestCatalog:
 
         with open(default_docs_path(), encoding="utf-8") as f:
             docs = f.read()
-        for family in ("J", "C", "R"):
+        from predictionio_tpu.analysis.engine import DOC_FAMILIES
+
+        assert "S" in DOC_FAMILIES
+        for family in DOC_FAMILIES:
             assert render_rule_table(family) in docs, (
                 f"{family}-series table stale: run pio check --update-docs"
             )
@@ -2561,3 +2571,947 @@ def test_precommit_entry_runs_changed_scope(monkeypatch, capsys):
     assert precommit.main([]) == 0
     assert seen["argv"][:3] == ["--changed", "--format", "text"]
     capsys.readouterr()
+
+
+# -- S-series: sharding semantics (meshflow) ----------------------------------
+
+class TestMeshFlow:
+    def test_mesh_literal_and_factory_axes(self):
+        index = build_index(
+            """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+
+            def local_mesh(data, model):
+                grid = np.array(jax.devices()[: data * model]).reshape(
+                    data, model
+                )
+                return Mesh(grid, ("data", "model"))
+
+            def use():
+                mesh = local_mesh(2, 2)
+                return mesh
+            """,
+        )
+        flow = index.meshflow()
+        key = ("predictionio_tpu/pkg/mod0.py", "local_mesh")
+        assert flow.factory_axes[key] == ("data", "model")
+        env = flow.fn_env[("predictionio_tpu/pkg/mod0.py", "use")]
+        (val,) = env["mesh"]
+        assert val.axes == ("data", "model")
+
+    def test_spec_literal_axes_and_module_consts(self):
+        index = build_index(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            ROW = P("data")
+            REP = P()
+
+            def specs():
+                fsh = P("model", None)
+                return fsh
+            """,
+        )
+        flow = index.meshflow()
+        consts = flow.module_consts["predictionio_tpu/pkg/mod0.py"]
+        (row,) = consts["ROW"]
+        assert row.axes == ("data",)
+        (rep,) = consts["REP"]
+        assert rep.axes == ()
+        env = flow.fn_env[("predictionio_tpu/pkg/mod0.py", "specs")]
+        (fsh,) = env["fsh"]
+        assert fsh.axes == ("model",)
+
+    def test_interprocedural_mesh_flow_binds_callee_param(self):
+        # the mint->consume chain: a mesh built in mod0 lands on mod1's
+        # parameter with the hand-off hop recorded
+        index = build_index(
+            """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+            from predictionio_tpu.pkg import mod1
+
+            def build():
+                mesh = Mesh(np.array(jax.devices()), ("data",))
+                return mod1.consume(mesh)
+            """,
+            """
+            def consume(mesh):
+                return mesh
+            """,
+        )
+        flow = index.meshflow()
+        vals = flow.param_vals[
+            (("predictionio_tpu/pkg/mod1.py", "consume"), "mesh")
+        ]
+        (val,) = vals
+        assert val.axes == ("data",)
+        assert val.path == "predictionio_tpu/pkg/mod0.py"
+        assert any("mod0.py:build" in hop for hop in val.trail)
+
+    def test_shard_map_site_resolves_partial_body_and_mesh(self):
+        index = build_index(
+            """
+            import functools
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def _block_body(x, rank):
+                return x
+
+            def fit(x):
+                mesh = Mesh(
+                    np.array(jax.devices()).reshape(2, 2), ("data", "model")
+                )
+                body = functools.partial(_block_body, rank=16)
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+                )
+                return sm(x)
+            """,
+        )
+        flow = index.meshflow()
+        (site,) = flow.shardmap_sites
+        assert [b.qual for b in site.bodies] == ["_block_body"]
+        assert [m.axes for m in site.mesh_vals] == [("data", "model")]
+        ctxs = flow.contexts_of(
+            ("predictionio_tpu/pkg/mod0.py", "_block_body"), "shard_map"
+        )
+        assert [c.axes for c in ctxs] == [("data", "model")]
+
+    def test_forwarding_wrapper_does_not_cross_product_callers(self):
+        # the seq_parallel_shard_map shape: a wrapper whose internal
+        # shard_map forwards its own (body, mesh) parameters must not
+        # seed contexts -- param bindings union EVERY caller's body
+        # against EVERY caller's mesh, convicting correct code under a
+        # mesh it never runs with; the caller-side sites carry the
+        # correct per-caller pairing
+        index = build_index(
+            """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def my_shard_map(body, mesh, axis_name):
+                return shard_map(
+                    body, mesh=mesh, in_specs=P(axis_name),
+                    out_specs=P(axis_name),
+                )
+
+            def body_seq(x):
+                return jax.lax.psum(x, "seq")
+
+            def body_model(x):
+                return jax.lax.psum(x, "model")
+
+            def fit_seq(x):
+                mesh = Mesh(
+                    np.array(jax.devices()).reshape(2, 4), ("data", "seq")
+                )
+                return my_shard_map(body_seq, mesh, "seq")(x)
+
+            def fit_model(x):
+                mesh = Mesh(
+                    np.array(jax.devices()).reshape(2, 4), ("data", "model")
+                )
+                return my_shard_map(body_model, mesh, "model")(x)
+            """,
+        )
+        findings = list(RuleS001().check_package(index))
+        # each body runs only under its own caller's mesh: zero findings
+        assert findings == [], [f.message for f in findings]
+        flow = index.meshflow()
+        # the wrapper-internal site is inventory-only; the two caller
+        # sites carry the per-caller pairing
+        assert len(flow.shardmap_sites) == 2
+        assert any("forwarding wrapper" in s.detail for s in flow.sites)
+
+    def test_parameter_shadows_module_level_mesh_constant(self):
+        # a param named like a module constant is whatever the caller
+        # passes -- never the shadowed global
+        index = build_index(
+            """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(np.array(jax.devices()), ("x",))
+
+            def place(mesh, arr):
+                return jax.device_put(arr, NamedSharding(mesh, P("model")))
+            """,
+        )
+        assert list(RuleS002().check_package(index)) == []
+
+    def test_helper_named_like_shard_map_is_not_a_site(self):
+        # the analyzer's own _record_shard_map/_check_shard_map shapes
+        index = build_index(
+            """
+            def _record_shard_map(fi, call):
+                return fi
+
+            def scan(fi, call):
+                return _record_shard_map(fi, call)
+            """,
+        )
+        assert index.meshflow().shardmap_sites == []
+
+
+class TestS001:
+    def test_fires_on_collective_over_axis_the_mesh_lacks(self):
+        findings = run_rule(RuleS001, """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def body(x):
+                return jax.lax.psum_scatter(x, "model", tiled=True)
+
+            def fit(x):
+                mesh = Mesh(np.array(jax.devices()), ("data",))
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+                )
+                return sm(x)
+        """)
+        assert len(findings) == 1
+        f = findings[0]
+        assert "psum_scatter" in f.message and "'model'" in f.message
+        assert len(f.witness) >= 2
+        assert f.related and f.related[0][2].startswith("mesh constructed")
+
+    def test_fires_on_collective_reached_from_jit_without_shard_map(self):
+        findings = run_rule(RuleS001, """
+            import jax
+
+            def helper(x):
+                return jax.lax.psum(x, "model")
+
+            def step(x):
+                return helper(x)
+
+            def fit(x):
+                prog = jax.jit(step)
+                return prog(x)
+        """)
+        assert len(findings) == 1
+        assert "no enclosing shard_map" in findings[0].message
+        # witness path walks jit seed -> step -> helper -> collective line
+        assert any("step" in hop for hop in findings[0].witness)
+
+    def test_shard_map_route_does_not_amnesty_unwrapped_jit_path(self):
+        # per-path join: the same collective helper reached through a
+        # binding shard_map AND directly from a jitted scope still
+        # convicts the unwrapped jit path
+        findings = run_rule(RuleS001, """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def allreduce(x):
+                return jax.lax.psum(x, "model")
+
+            def body(x):
+                return allreduce(x)
+
+            def good_fit(x):
+                mesh = Mesh(
+                    np.array(jax.devices()).reshape(2, 2), ("data", "model")
+                )
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+                )
+                return sm(x)
+
+            def bad_step(x):
+                return allreduce(x)
+
+            def bad_fit(x):
+                return jax.jit(bad_step)(x)
+        """)
+        assert len(findings) == 1
+        assert "no enclosing shard_map" in findings[0].message
+        assert any("bad_step" in hop for hop in findings[0].witness)
+
+    def test_silent_when_mesh_binds_the_axis(self):
+        findings = run_rule(RuleS001, """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def body(x):
+                g = jax.lax.psum_scatter(
+                    x, "model", scatter_dimension=0, tiled=True
+                )
+                return g
+
+            def fit(x):
+                mesh = Mesh(
+                    np.array(jax.devices()).reshape(2, 2), ("data", "model")
+                )
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+                )
+                return sm(x)
+        """)
+        assert findings == []
+
+    def test_silent_on_unresolved_mesh_and_variable_axis(self):
+        # an unknown mesh binds everything; a variable axis name is
+        # honestly unknown (the jax_compat axis_size shape)
+        findings = run_rule(RuleS001, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def body(x, axis_name):
+                return jax.lax.psum(x, axis_name)
+
+            def fit(x, mesh):
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+                )
+                return sm(x)
+        """)
+        assert findings == []
+
+
+class TestS002:
+    def test_fires_on_spec_placed_on_mesh_without_its_axis(self):
+        findings = run_rule(RuleS002, """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            def place(x):
+                mesh = Mesh(np.array(jax.devices()), ("data",))
+                spec = P("model")
+                return jax.device_put(x, NamedSharding(mesh, spec))
+        """)
+        assert len(findings) == 1
+        f = findings[0]
+        assert "'model'" in f.message and "['data']" in f.message
+        labels = {r[2].split(" ")[0] for r in f.related}
+        assert labels == {"mesh", "PartitionSpec"}
+
+    def test_fires_on_shard_map_spec_naming_foreign_axis(self):
+        findings = run_rule(RuleS002, """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def body(x):
+                return x
+
+            def fit(x):
+                mesh = Mesh(np.array(jax.devices()), ("data",))
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("model"), out_specs=P("model")
+                )
+                return sm(x)
+        """)
+        assert len(findings) == 1
+        assert "shard_map specs" in findings[0].message
+
+    def test_concat_reshard_incident_shape_on_wrong_mesh(self):
+        # the J005 incident's S-twin: the concat output resharded to
+        # P("model") -- on a per-engine slice mesh WITHOUT a model axis
+        # the placement itself is wrong before GSPMD even runs
+        findings = run_rule(RuleS002, """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            def assemble(outs):
+                mesh = Mesh(np.array(jax.devices()), ("data",))
+                buf = jnp.concatenate(outs, axis=0)
+                return jax.device_put(buf, NamedSharding(mesh, P("model")))
+        """)
+        assert len(findings) == 1
+        assert "'model'" in findings[0].message
+
+    def test_silent_when_axes_match_or_mesh_unknown(self):
+        findings = run_rule(RuleS002, """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            def good(x):
+                mesh = Mesh(
+                    np.array(jax.devices()).reshape(2, 2), ("data", "model")
+                )
+                return jax.device_put(x, NamedSharding(mesh, P("model")))
+
+            def unknown(x, mesh):
+                return jax.device_put(x, NamedSharding(mesh, P("model")))
+        """)
+        assert findings == []
+
+    def test_replicated_spec_is_always_silent(self):
+        findings = run_rule(RuleS002, """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            def place(x):
+                mesh = Mesh(np.array(jax.devices()), ("data",))
+                return jax.device_put(x, NamedSharding(mesh, P()))
+        """)
+        assert findings == []
+
+
+class TestS003:
+    def test_fires_on_unwrapped_pallas_under_multi_axis_mesh(self):
+        # the "opaque to GSPMD" incident: jitted scope, 2x2 mesh in the
+        # module, pallas_call with no shard_map on the path
+        findings = run_rule(RuleS003, """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import pallas as pl
+
+            def kernel_host(x):
+                return pl.pallas_call(_kern, out_shape=x)(x)
+
+            def run_step(x):
+                return kernel_host(x)
+
+            def train(x):
+                mesh = Mesh(
+                    np.array(jax.devices()).reshape(2, 2), ("data", "model")
+                )
+                step = jax.jit(
+                    run_step, in_shardings=NamedSharding(mesh, P("data"))
+                )
+                return step(x)
+        """)
+        assert len(findings) == 1
+        f = findings[0]
+        assert "opaque to GSPMD" in f.message
+        assert f.related and "axes=['data', 'model']" in f.related[0][2]
+
+    def test_shard_map_routing_is_the_negative(self):
+        # parallel/als.py's fix shape: the kernel body rides an explicit
+        # shard_map; the jit wraps the OUTER program
+        findings = run_rule(RuleS003, """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map, pallas as pl
+
+            def _sharded_block_body(x):
+                return pl.pallas_call(_kern, out_shape=x)(x)
+
+            def fit(x):
+                mesh = Mesh(
+                    np.array(jax.devices()).reshape(2, 2), ("data", "model")
+                )
+                sm = shard_map(
+                    _sharded_block_body, mesh=mesh,
+                    in_specs=P("data"), out_specs=P("data"),
+                )
+                step = jax.jit(lambda v: sm(v))
+                return step(x)
+        """)
+        assert findings == []
+
+    def test_single_device_jit_without_mesh_is_silent(self):
+        findings = run_rule(RuleS003, """
+            import jax
+            from predictionio_tpu.utils.jax_compat import pallas as pl
+
+            def kernel_host(x):
+                return pl.pallas_call(_kern, out_shape=x)(x)
+
+            def serve(x):
+                step = jax.jit(kernel_host)
+                return step(x)
+        """)
+        assert findings == []
+
+
+class TestS004:
+    def test_fires_on_post_donation_read_of_adam_state(self):
+        findings = run_rule(RuleS004, """
+            import jax
+
+            def train_step(params, opt_state, batch):
+                step = jax.jit(_step, donate_argnums=(1,))
+                new_params, new_opt = step(params, opt_state)
+                grad_norm = opt_state[0]
+                return new_params, new_opt, grad_norm
+        """)
+        assert len(findings) == 1
+        f = findings[0]
+        assert "read-after-donate" in f.message and "opt_state" in f.message
+        assert f.related[0][2] == "donating jit constructed here"
+
+    def test_fires_on_donation_in_loop_without_rebind(self):
+        findings = run_rule(RuleS004, """
+            import jax
+
+            def fit(state, blocks):
+                step = jax.jit(_step, donate_argnums=(0,))
+                outs = []
+                for block in blocks:
+                    outs.append(step(state, block))
+                return outs
+        """)
+        assert len(findings) == 1
+        assert "never rebound in the loop body" in findings[0].message
+
+    def test_multiline_donated_call_own_args_are_not_reads(self):
+        # a black-wrapped call puts the donated name on a continuation
+        # line past call.lineno -- that load is the call itself
+        findings = run_rule(RuleS004, """
+            import jax
+
+            def train(params, opt_state, batch):
+                step = jax.jit(_step, donate_argnums=(1,))
+                params, opt_state = step(
+                    params,
+                    opt_state,
+                )
+                return params, opt_state
+        """)
+        assert findings == []
+
+    def test_rebinding_from_the_result_is_the_negative(self):
+        findings = run_rule(RuleS004, """
+            import jax
+
+            def train(params, opt_state, batches):
+                step = jax.jit(_step, donate_argnums=(0, 1))
+                for batch in batches:
+                    params, opt_state = step(params, opt_state)
+                return params, opt_state
+        """)
+        assert findings == []
+
+    def test_legacy_gated_donation_is_the_negative(self):
+        # the J002 fix shape: the gate exists to keep donation correct
+        findings = run_rule(RuleS004, """
+            import jax
+            from predictionio_tpu.utils.jax_compat import IS_LEGACY_JAX
+
+            def train(params, opt_state, batch):
+                step = jax.jit(
+                    _step,
+                    donate_argnums=(0,) if IS_LEGACY_JAX else (0, 1),
+                )
+                params, opt_state = step(params, opt_state)
+                print(opt_state)
+                return params
+        """)
+        assert findings == []
+
+    def test_donate_argnames_resolved_through_callee_params(self):
+        findings = run_rule(RuleS004, """
+            import jax
+
+            def _step(params, opt_state, batch):
+                return params, opt_state
+
+            def train(params, opt_state, batch):
+                step = jax.jit(_step, donate_argnames=("opt_state",))
+                new_params, new_opt = step(params, opt_state, batch)
+                return new_params, new_opt, opt_state
+        """)
+        assert len(findings) == 1
+        assert "opt_state" in findings[0].message
+
+    def test_self_attr_donation_checked_across_methods(self):
+        findings = run_rule(RuleS004, """
+            import jax
+
+            class Trainer:
+                def __init__(self):
+                    self._step = jax.jit(_step, donate_argnums=(1,))
+
+                def fit(self, params, opt_state, batch):
+                    new_params, new_opt = self._step(params, opt_state)
+                    return new_params, new_opt, opt_state.shape
+        """)
+        assert len(findings) == 1
+        assert findings[0].symbol == "Trainer.fit"
+
+
+class TestS005:
+    def test_fires_on_device_put_inside_shard_map_body(self):
+        findings = run_rule(RuleS005, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def body(x, sharding):
+                return jax.device_put(x, sharding)
+
+            def fit(x, mesh, sharding):
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+                )
+                return sm(x)
+        """)
+        assert len(findings) == 1
+        assert "per-shard code applying global placement" in findings[0].message
+
+    def test_fires_on_constraint_below_the_body_with_witness(self):
+        findings = run_rule(RuleS005, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def helper(x, spec):
+                return jax.lax.with_sharding_constraint(x, spec)
+
+            def body(x, spec):
+                return helper(x, spec)
+
+            def fit(x, mesh, spec):
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+                )
+                return sm(x)
+        """)
+        assert len(findings) == 1
+        assert any("body" in hop for hop in findings[0].witness)
+
+    def test_constraint_outside_the_body_is_the_negative(self):
+        # the parallel/als.py committed shape: constraints only in the
+        # jitted caller, dynamic_update_slice assembly outside shard_map
+        findings = run_rule(RuleS005, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+
+            def body(x):
+                return x
+
+            def fit(x, mesh, fsh):
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+                )
+                out = sm(x)
+                buf = jax.lax.with_sharding_constraint(out, fsh)
+                return jax.lax.dynamic_update_slice(buf, out, (0, 0))
+        """)
+        assert findings == []
+
+
+class TestSWitnessPaths:
+    def test_two_module_mint_to_consume_chain_renders(self):
+        # a P("model") minted in mod0 and consumed one module down in
+        # mod1 is joined against the mesh it actually lands on, and the
+        # finding's witness walks both files
+        index = build_index(
+            """
+            from jax.sharding import PartitionSpec as P
+            from predictionio_tpu.pkg import mod1
+
+            def mint_and_place(x):
+                spec = P("model")
+                return mod1.consume(x, spec)
+            """,
+            """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding
+
+            def consume(x, spec):
+                mesh = Mesh(np.array(jax.devices()), ("data",))
+                return jax.device_put(x, NamedSharding(mesh, spec))
+            """,
+        )
+        findings = list(RuleS002().check_package(index))
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.path == "predictionio_tpu/pkg/mod1.py"
+        # witness: spec mint in mod0 -> hand-off hop -> consume in mod1
+        assert any("mod0.py" in hop for hop in f.witness)
+        assert any("mod1.py" in hop for hop in f.witness)
+        related_paths = {r[0] for r in f.related}
+        assert related_paths == {
+            "predictionio_tpu/pkg/mod0.py", "predictionio_tpu/pkg/mod1.py",
+        }
+
+    def test_s001_witness_walks_call_chain_below_the_body(self):
+        index = build_index(
+            """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from predictionio_tpu.utils.jax_compat import shard_map
+            from predictionio_tpu.pkg import mod1
+
+            def body(x):
+                return mod1.reduce_model(x)
+
+            def fit(x):
+                mesh = Mesh(np.array(jax.devices()), ("data",))
+                sm = shard_map(
+                    body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+                )
+                return sm(x)
+            """,
+            """
+            import jax
+
+            def reduce_model(x):
+                return jax.lax.psum(x, "model")
+            """,
+        )
+        findings = list(RuleS001().check_package(index))
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.path == "predictionio_tpu/pkg/mod1.py"
+        hops = list(f.witness)
+        # seed site (the shard_map call in mod0) comes first, the
+        # collective's own line last
+        assert "mod0.py" in hops[0]
+        assert hops[-1].startswith("predictionio_tpu/pkg/mod1.py:reduce_model:")
+
+
+class TestMeshReport:
+    def test_cli_text_lists_known_sites(self, capsys):
+        from predictionio_tpu.analysis.engine import run_cli
+
+        assert run_cli(["--mesh-report"]) == 0
+        out = capsys.readouterr().out
+        # the canonical mesh factory and the ALS shard_map routing
+        assert "predictionio_tpu/parallel/mesh.py" in out
+        assert "[mesh]" in out and "axes=['data', 'model']" in out
+        assert "[shard_map]" in out and "_sharded_block_body" in out
+        assert "mesh-report:" in out
+
+    def test_json_inventory_complete_against_ast_scan(self, capsys):
+        """The acceptance spot-check: every Mesh/PartitionSpec/
+        NamedSharding/shard_map construction site an independent AST scan
+        finds in parallel/ and ops/ appears in the report."""
+        import ast as ast_mod
+        import os
+
+        from predictionio_tpu.analysis.engine import package_root, run_cli
+
+        assert run_cli(["--mesh-report", "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        reported = {(s["path"], s["line"]) for s in doc["sites"]}
+        scanned = set()
+        pkg = package_root()
+        root = os.path.dirname(pkg)
+        for sub in ("parallel", "ops"):
+            subdir = os.path.join(pkg, sub)
+            for name in sorted(os.listdir(subdir)):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(subdir, name)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                with open(path, encoding="utf-8") as fh:
+                    tree = ast_mod.parse(fh.read())
+                for node in ast_mod.walk(tree):
+                    if not isinstance(node, ast_mod.Call):
+                        continue
+                    fn = node.func
+                    last = None
+                    if isinstance(fn, ast_mod.Name):
+                        last = fn.id
+                    elif isinstance(fn, ast_mod.Attribute):
+                        last = fn.attr
+                    if last in ("Mesh", "PartitionSpec", "P",
+                                "NamedSharding") or (
+                        last == "shard_map" and node.args
+                    ):
+                        scanned.add((rel, node.lineno))
+        missing = scanned - reported
+        assert not missing, f"mesh-report missed sites: {sorted(missing)}"
+
+    def test_mesh_report_rejects_sarif_and_bad_paths(self, capsys):
+        from predictionio_tpu.analysis.engine import run_cli
+
+        assert run_cli(["--mesh-report", "--format", "sarif"]) == 2
+        assert "sarif" in capsys.readouterr().out
+        assert run_cli(["--mesh-report", "no/such/dir"]) == 2
+        assert "no such file" in capsys.readouterr().out
+
+
+# -- --changed: deleted/renamed files resolve to survivors --------------------
+
+class TestChangedSurvivingPaths:
+    def _git(self, cwd, *args):
+        import subprocess
+
+        subprocess.run(
+            ["git", *args], cwd=cwd, check=True, capture_output=True,
+            env={"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+                 "HOME": str(cwd), "PATH": __import__("os").environ["PATH"]},
+        )
+
+    def test_deleted_and_renamed_resolve_to_survivors(
+        self, tmp_path, monkeypatch
+    ):
+        # regression: a diff containing a deleted file and a renamed
+        # file must scope to the SURVIVING paths only -- the deleted
+        # path must not reach the parser, the rename must appear under
+        # its new name
+        from predictionio_tpu.analysis import engine
+
+        (tmp_path / "doomed.py").write_text("x = 1\n")
+        (tmp_path / "moves.py").write_text("y = 2\n")
+        (tmp_path / "stays.py").write_text("z = 3\n")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", ".")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        (tmp_path / "doomed.py").unlink()
+        self._git(tmp_path, "mv", "moves.py", "renamed.py")
+        (tmp_path / "stays.py").write_text("z = 4\n")
+        monkeypatch.setattr(engine, "repo_root", lambda: str(tmp_path))
+        changed = engine.changed_files()
+        assert "doomed.py" not in changed
+        assert "moves.py" not in changed
+        assert "renamed.py" in changed and "stays.py" in changed
+
+    def test_changed_scope_with_ghost_path_never_crashes(
+        self, monkeypatch, capsys
+    ):
+        # belt-and-suspenders: even if git hands back a path that no
+        # longer exists (rename-detection drift between git versions, a
+        # file deleted mid-run), the sweep skips it instead of raising
+        from predictionio_tpu.analysis import engine
+
+        monkeypatch.setattr(
+            engine, "changed_files",
+            lambda: ["predictionio_tpu/does_not_exist_anymore.py",
+                     "predictionio_tpu/workflow/microbatch.py"],
+        )
+        rc = engine.run_cli(["--changed"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+
+    def test_parse_module_on_missing_path_returns_none(self, tmp_path):
+        from predictionio_tpu.analysis.engine import parse_module
+
+        assert parse_module(str(tmp_path / "gone.py")) is None
+
+
+def test_changed_picks_up_s_rules_automatically(tmp_path, monkeypatch, capsys):
+    """The pre-commit path runs the full rule set: an S-positive file in
+    the changed scope reports its S finding with no extra wiring."""
+    from predictionio_tpu.analysis import engine
+
+    pkg = tmp_path / "predictionio_tpu" / "pkg"
+    pkg.mkdir(parents=True)
+    (tmp_path / "predictionio_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent("""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def place(x):
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+            return jax.device_put(x, NamedSharding(mesh, P("model")))
+    """))
+    monkeypatch.setattr(engine, "repo_root", lambda: str(tmp_path))
+    monkeypatch.setattr(
+        engine, "package_root", lambda: str(tmp_path / "predictionio_tpu")
+    )
+    monkeypatch.setattr(
+        engine, "changed_files", lambda: ["predictionio_tpu/pkg/mod.py"]
+    )
+    rc = engine.run_cli(["--changed", "--baseline", "none",
+                         "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["rule_id"] for f in doc["findings"]] == ["S002"]
+
+
+# -- SARIF: related locations + S-family round-trip ---------------------------
+
+class TestSarifRelatedLocations:
+    def test_mint_sites_render_as_related_locations(self):
+        from predictionio_tpu.analysis import all_rules, parse_source
+        from predictionio_tpu.analysis.engine import render_sarif
+
+        ctx = parse_source(textwrap.dedent("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            def place(x):
+                mesh = Mesh(np.array(jax.devices()), ("data",))
+                spec = P("model")
+                return jax.device_put(x, NamedSharding(mesh, spec))
+        """), "predictionio_tpu/pkg/mod.py")
+        hits = list(RuleS002().check(ctx))
+        assert len(hits) == 1
+        sarif = json.loads(render_sarif(hits, [], all_rules()))
+        result = sarif["runs"][0]["results"][0]
+        related = result["relatedLocations"]
+        assert len(related) == len(hits[0].related) == 2
+        by_line = {
+            r["physicalLocation"]["region"]["startLine"]:
+            r["message"]["text"]
+            for r in related
+        }
+        assert any("mesh constructed here" in t for t in by_line.values())
+        assert any("PartitionSpec constructed" in t for t in by_line.values())
+        # and the witness rides as a codeFlow like the R rules'
+        assert result["codeFlows"][0]["threadFlows"][0]["locations"]
+
+    def test_json_format_carries_related_field(self):
+        from dataclasses import asdict
+
+        f = Finding(
+            "S002", "error", "pkg/a.py", 9, "place", "msg",
+            related=(("pkg/a.py", 7, "mesh constructed here"),),
+        )
+        doc = json.loads(json.dumps(asdict(f)))
+        assert doc["related"] == [["pkg/a.py", 7, "mesh constructed here"]]
+
+
+# -- budgets: the S family inside the tier-1 sweep ----------------------------
+
+def test_s_family_sweep_stays_under_two_seconds_solo():
+    """bench #10's S key: the meshflow build + all five S rules over the
+    whole package, solo, inside 2 s on the 2-core box (the full
+    J+C+R+S sweep budget stays 10 s, asserted by the repo-wide gate)."""
+    from predictionio_tpu.analysis.engine import select_rules
+
+    timings = {}
+    best = float("inf")
+    for _ in range(2):
+        t = {}
+        check_paths(
+            rules=select_rules(["S001", "S002", "S003", "S004", "S005"]),
+            timings=t,
+        )
+        if t["families"]["S"] < best:
+            best = t["families"]["S"]
+            timings = t
+    assert "S" in timings["families"]
+    assert best < 2.0, f"S family took {best:.2f}s solo (budget 2s)"
+
+
+def test_full_sweep_timings_grow_the_s_family_key():
+    timings = {}
+    check_paths(timings=timings)
+    assert set("JCRS") <= set(timings["families"]), timings["families"]
+
+
+def test_analysis_rules_total_includes_s_family():
+    from predictionio_tpu.analysis import all_rules
+
+    ids = {r.rule_id for r in all_rules()}
+    assert {"S001", "S002", "S003", "S004", "S005"} <= ids
+    assert len(ids) == 20

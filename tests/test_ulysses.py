@@ -83,3 +83,40 @@ def test_sasrec_trains_with_ulysses():
     params, _ = train_sasrec(config, seqs, mesh)
     leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
     assert all(np.isfinite(l).all() for l in leaves)
+
+
+class TestMeshAxisValidation:
+    """require_axes: the runtime twin of pio check S001/S002 -- a spec
+    axis the mesh does not bind fails eagerly with both sides named,
+    not deep inside a trace (the MPMD slice directions end the
+    ("data", "model") mesh singleton, so helpers must not assume it)."""
+
+    def test_seq_parallel_shard_map_rejects_unbound_axis(self):
+        from predictionio_tpu.parallel.mesh import (
+            local_mesh,
+            seq_parallel_shard_map,
+        )
+
+        mesh = local_mesh(1, 1)   # axes ("data", "model"): no "seq"
+        with pytest.raises(ValueError, match=r"'seq'.*data.*model"):
+            seq_parallel_shard_map(lambda *a: a, mesh, "seq")
+
+    def test_row_sharded_and_shard_rows_reject_unbound_axis(self):
+        from predictionio_tpu.parallel.mesh import (
+            local_mesh,
+            row_sharded,
+            shard_rows,
+        )
+
+        mesh = local_mesh(1, 1)
+        with pytest.raises(ValueError, match="row_sharded"):
+            row_sharded(mesh, "seq")
+        with pytest.raises(ValueError, match="shard_rows"):
+            shard_rows(mesh, np.zeros((4, 2), np.float32), axis="seq")
+
+    def test_bound_axes_pass_through(self):
+        from predictionio_tpu.parallel.mesh import local_mesh, row_sharded
+
+        mesh = local_mesh(1, 1)
+        assert row_sharded(mesh, "data") is not None
+        assert row_sharded(mesh, "model") is not None
