@@ -579,6 +579,78 @@ def secondary_main(result_path: str) -> None:
         )
         return res
 
+    def mips_topk():
+        """#15: two-stage quantized MIPS retrieval (ops/mips) vs the full
+        scan over a 1M-item synthetic catalog. TPU: times
+        RetrievalIndex.search end-to-end (shortlisted items/sec +
+        achieved GB/s against the packed-table bytes model). CPU child:
+        the kernel only runs under the Pallas interpreter, and timing it
+        at catalog scale would measure the interpreter (the
+        als_half_step_gbps precedent) -- so recall@10 is measured through
+        the numpy REFERENCE of the same quantized stage-1 math
+        (ops.mips.reference_shortlist) and the bytes-model ratio is
+        reported; the kernel-timing rerun rides the ROADMAP
+        first-real-hardware item."""
+        from predictionio_tpu.ops.mips import (
+            RetrievalConfig,
+            RetrievalIndex,
+            mips_bytes,
+            reference_shortlist,
+            scan_bytes,
+        )
+
+        rng = np.random.default_rng(115)
+        rank = 16
+        # 1M default; PIO_BENCH_MIPS_ITEMS=10000000 is the 10M variant
+        # (deliberately not default: it owns ~2 GB of host arrays)
+        n_items = int(os.environ.get("PIO_BENCH_MIPS_ITEMS", "1000000"))
+        batch = 64 if tpu else 16  # CPU reference math holds [B, items]
+        conf = RetrievalConfig(mode="mips")
+        b_mips = mips_bytes(
+            n_items, rank, batch,
+            conf.block_items, conf.block_topk, conf.shortlist,
+        )
+        b_scan = scan_bytes(n_items, rank, batch)
+        res = {
+            "items": n_items, "rank": rank, "batch": batch,
+            "shortlist": conf.shortlist, "block_topk": conf.block_topk,
+            "bytes_mips": b_mips, "bytes_scan": b_scan,
+            "model_bytes_ratio": round(b_scan / b_mips, 2),
+            "config": "#15 mips_topk (bytes model: ops.mips)",
+        }
+        factors = rng.standard_normal((n_items, rank)).astype(np.float32)
+        queries = rng.standard_normal((batch, rank)).astype(np.float32)
+        exact = queries @ factors.T
+        true_top = np.argpartition(-exact, 9, axis=1)[:, :10]
+        if not tpu:
+            sel = reference_shortlist(factors, queries, conf)
+            hits = sum(
+                len(set(true_top[row].tolist()) & set(sel[row].tolist()))
+                for row in range(batch)
+            )
+            res["recall_at_10"] = round(hits / (batch * 10), 4)
+            res["kernel_timing"] = (
+                "skipped on CPU (interpret mode times the interpreter;"
+                " rerun queued on the ROADMAP first-real-hardware item)"
+            )
+            return res
+        index = RetrievalIndex(factors, conf)
+        idx, _ = index.search(queries)  # compile + warm outside the clock
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            idx, _ = index.search(queries)
+        sec = (time.perf_counter() - t0) / reps
+        hits = sum(
+            len(set(true_top[row].tolist()) & set(idx[row].tolist()))
+            for row in range(batch)
+        )
+        res["recall_at_10"] = round(hits / (batch * 10), 4)
+        res["sec_per_batch"] = round(sec, 5)
+        res["shortlisted_items_per_sec"] = round(n_items * batch / sec, 1)
+        res["gbps_packed"] = round(b_mips / sec / 1e9, 2)
+        return res
+
     def trace_overhead_pct():
         """#11: serving qps with the span tracer enabled (the production
         default: headerless roots head-sampled 1-in-8, traceparent'd
@@ -819,6 +891,7 @@ def secondary_main(result_path: str) -> None:
     phase("ingest_eps", ingest_eps)
     phase("train_data_eps", train_data_eps)
     phase("als_half_step_gbps", als_half_step_gbps)
+    phase("mips_topk", mips_topk)
     phase("trace_overhead_pct", trace_overhead_pct)
     phase("serving_qps_multiproc", serving_qps_multiproc)
     phase("als_stream", als_stream)

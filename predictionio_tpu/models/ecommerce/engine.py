@@ -36,11 +36,16 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.base import SanityCheck
 from predictionio_tpu.data.store import LEventStore, PEventStore
 from predictionio_tpu.models._als_common import (
+    Shortlist,
     batch_score_known_users,
     build_seen,
     fit_with_checkpoint,
     partition_user_queries,
     prepare_als_data,
+    resolve_retrieval,
+    retrieval_index,
+    score_known_user,
+    similar_item_scores,
     topk_item_scores,
     warn_misplaced_packing_params,
 )
@@ -285,7 +290,9 @@ class ECommAlgorithm(TPUAlgorithm):
     True), similarEvents (events anchoring cold users, default ["view"]),
     recentCount (how many recent views to anchor on, default 10; a query
     may override it), checkpointInterval (iterations between step
-    checkpoints; 0 disables).
+    checkpoints; 0 disables), retrieval ({"mode": "scan"|"mips", ...} --
+    the two-stage quantized device retrieval of ``ops/mips``; see
+    docs/templates.md for the knobs and the recall contract).
     """
 
     def _config(self) -> ALSConfig:
@@ -305,9 +312,18 @@ class ECommAlgorithm(TPUAlgorithm):
             solver=p.get_or("alsSolver", "auto"),
         )
 
+    @property
+    def _retrieval(self):
+        conf = getattr(self, "_retrieval_conf", None)
+        if conf is None:
+            conf = resolve_retrieval(self.params)
+            self._retrieval_conf = conf
+        return conf
+
     def train(self, ctx, prepared) -> ECommerceModel:
         data, als_data = prepared
         warn_misplaced_packing_params(self.params, "ecommerce")
+        self._retrieval  # a retrieval typo fails the build, not a query
         model = fit_with_checkpoint(
             ctx,
             als_data,
@@ -457,6 +473,10 @@ class ECommAlgorithm(TPUAlgorithm):
 
     def warm_up(self, model: ECommerceModel) -> None:
         model.als.item_norms  # cold-user similarity norm cache, at deploy
+        # mips mode: pack + compile the retrieval index at deploy, not on
+        # the first query (dot for user scoring, cosine for cold anchors)
+        retrieval_index(model.als, self._retrieval)
+        retrieval_index(model.als, self._retrieval, kind="cosine")
 
     @staticmethod
     def _seen(model: ECommerceModel, query, user_idx, cache) -> set[int]:
@@ -507,7 +527,10 @@ class ECommAlgorithm(TPUAlgorithm):
             "unseenOnly", self.params.get_or("unseenOnly", True)
         ):
             exclude |= self._seen(model, query, user_idx, seen_cache)
-        scores = np.where(allowed, scores, -np.inf)
+        if isinstance(scores, Shortlist):
+            scores.where_allowed(allowed)  # O(shortlist), stays compact
+        else:
+            scores = np.where(allowed, scores, -np.inf)
         for j in exclude:
             scores[j] = -np.inf
         return topk_item_scores(model.item_ids, scores, int(query.get("num", 10)))
@@ -522,10 +545,7 @@ class ECommAlgorithm(TPUAlgorithm):
         )
         if not anchors:
             return [], None
-        scores = np.zeros(len(model.item_ids), dtype=np.float32)
-        for a in anchors:
-            scores += model.als.similar_items(a)
-        return anchors, scores
+        return anchors, similar_item_scores(model.als, anchors, self._retrieval)
 
     def predict(self, model: ECommerceModel, query) -> dict:
         user = str(query.get("user", ""))
@@ -534,7 +554,7 @@ class ECommAlgorithm(TPUAlgorithm):
         user_idx = model.user_index.get(user)
         anchors: list[int] = []
         if user_idx is not None:
-            scores = model.als.score_items_for_user(user_idx)
+            scores = score_known_user(model.als, user_idx, self._retrieval)
         else:
             anchors, scores = self._cold_scores(model, query, user)
             if scores is None:
@@ -563,6 +583,7 @@ class ECommAlgorithm(TPUAlgorithm):
                     seen_cache=seen_cache,
                 ),
             ),
+            retrieval=self._retrieval,
         )
         for qid, q in fallback:
             user = str(q.get("user", "")) if isinstance(q, dict) else ""

@@ -28,6 +28,10 @@ from predictionio_tpu.models._als_common import (
     fit_with_checkpoint,
     partition_user_queries,
     prepare_als_data,
+    resolve_retrieval,
+    retrieval_index,
+    score_known_user,
+    similar_item_scores,
     topk_item_scores,
     warn_misplaced_packing_params,
 )
@@ -262,8 +266,20 @@ class ALSAlgorithm(TPUAlgorithm):
 
     Params: rank, numIterations, lambda, alpha, implicitPrefs, seed,
     checkpointInterval (iterations between step checkpoints; 0 disables --
-    the preemption-safety net `pio train --resume` continues from).
+    the preemption-safety net `pio train --resume` continues from), and
+    ``retrieval`` (``{"mode": "scan"|"mips", ...}``: scan is the full
+    [rows, items] host matmul; mips serves through the device-resident
+    two-stage quantized top-k of ``ops/mips`` -- docs/templates.md lists
+    the knobs and the recall contract).
     """
+
+    @property
+    def _retrieval(self):
+        conf = getattr(self, "_retrieval_conf", None)
+        if conf is None:
+            conf = resolve_retrieval(self.params)
+            self._retrieval_conf = conf
+        return conf
 
     def _config(self) -> ALSConfig:
         p = self.params
@@ -288,6 +304,7 @@ class ALSAlgorithm(TPUAlgorithm):
     def train(self, ctx, prepared) -> RecommendationModel:
         ratings_data, als_data = prepared
         warn_misplaced_packing_params(self.params, "recommendation")
+        self._retrieval  # a retrieval typo fails the build, not a query
         streamed = getattr(ratings_data, "streamed", False)
         seen_mode = self.params.get_or(
             "seenFilter", "live" if streamed else "model"
@@ -343,6 +360,10 @@ class ALSAlgorithm(TPUAlgorithm):
 
     def warm_up(self, model: RecommendationModel) -> None:
         model.als.item_norms  # build the similar-items norm cache at deploy
+        # mips mode: pack + compile the retrieval index at deploy, not on
+        # the first query (dot for user scoring, cosine for similar-items)
+        retrieval_index(model.als, self._retrieval)
+        retrieval_index(model.als, self._retrieval, kind="cosine")
 
     supports_fold_in = True
 
@@ -421,6 +442,7 @@ class ALSAlgorithm(TPUAlgorithm):
                     seen=seen_for(q, user_idx),
                 ),
             ),
+            retrieval=self._retrieval,
         )
         out.extend((qid, self.predict(model, q)) for qid, q in fallback)
         return out
@@ -452,11 +474,10 @@ class ALSAlgorithm(TPUAlgorithm):
         user_idx = model.user_index.get(str(query["user"]))
         if user_idx is None:
             return {"itemScores": []}  # cold user: reference returns empty
-        scores = model.als.score_items_for_user(user_idx)
+        scores = score_known_user(model.als, user_idx, self._retrieval)
         return self._topk_response(model, scores, query, num, user_idx)
 
     def _similar_items(self, model: RecommendationModel, query, num: int) -> dict:
-        sims = None
         anchors = [
             model.item_index[str(item)]
             for item in query["items"]
@@ -464,9 +485,7 @@ class ALSAlgorithm(TPUAlgorithm):
         ]
         if not anchors:
             return {"itemScores": []}
-        for idx in anchors:
-            s = model.als.similar_items(idx)
-            sims = s if sims is None else sims + s
+        sims = similar_item_scores(model.als, anchors, self._retrieval)
         for idx in anchors:
             sims[idx] = -np.inf
         return topk_item_scores(model.item_ids, sims, num)

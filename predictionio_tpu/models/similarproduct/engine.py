@@ -25,7 +25,11 @@ from predictionio_tpu.ops.cooccurrence import (
     cooccurrence_indicators,
     distinct_user_counts,
 )
-from predictionio_tpu.models._als_common import topk_order
+from predictionio_tpu.models._als_common import (
+    Shortlist,
+    resolve_retrieval,
+    topk_order,
+)
 from predictionio_tpu.models._streaming import (
     StreamingHandle,
     live_target_events,
@@ -145,9 +149,23 @@ def _user_anchor_items(model: "SimilarityModel", user: str) -> list[int]:
 
 class CooccurrenceAlgorithm(TPUAlgorithm):
     """Params: topK (indicators per item, default 50), llr (default True),
-    chunk (users per device matmul chunk)."""
+    chunk (users per device matmul chunk), retrieval ({"mode":
+    "scan"|"mips"} -- mips serves from a compact union of the anchors'
+    indicator entries instead of a dense [items] buffer; scores are EXACT
+    here since each anchor touches only its topK indicator columns, so
+    the knob trades nothing and exists for the shared engine-param
+    surface; the quantization knobs are ignored)."""
+
+    @property
+    def _retrieval(self):
+        conf = getattr(self, "_retrieval_conf", None)
+        if conf is None:
+            conf = resolve_retrieval(self.params)
+            self._retrieval_conf = conf
+        return conf
 
     def train(self, ctx, data) -> SimilarityModel:
+        self._retrieval  # a retrieval typo fails the build, not a query
         chunk = self.params.get_or("chunk", 4096)
         mesh = self.mesh_or_none(ctx)  # user rows dp-sharded, psum acc
         streamed = isinstance(data, StreamingHandle)
@@ -243,12 +261,27 @@ class CooccurrenceAlgorithm(TPUAlgorithm):
         keep = vals > 0
         return idx[keep], vals[keep]
 
+    @classmethod
+    def _compact_scores(cls, model: SimilarityModel, anchors: list[int]) -> Shortlist:
+        """The anchors' summed indicator scores as a compact ``Shortlist``
+        (ascending union of touched columns): O(anchors * topK) memory
+        instead of a dense [items] buffer, and EXACT -- indicator tables
+        are already top-K sparse, so the union IS the support. The f64
+        accumulation matches the dense path bit-for-bit."""
+        cols, vals = cls._anchor_contributions(model, anchors)
+        uniq, inv = np.unique(cols, return_inverse=True)
+        scores = np.zeros(uniq.size, np.float64)
+        np.add.at(scores, inv, vals)
+        return Shortlist(uniq, scores, len(model.item_ids))
+
     @staticmethod
-    def _topk_response(model: SimilarityModel, scores: np.ndarray, query,
+    def _topk_response(model: SimilarityModel, scores, query,
                        anchors: list[int]) -> dict:
         """Shared exclusion + ranking tail (predict and batch_predict must
         rank identically). The exclusion sentinel here is 0, not -inf:
-        only positively-scored items are ever emitted."""
+        only positively-scored items are ever emitted. A ``Shortlist``
+        ranks over its compact arrays -- ascending indices mean the stable
+        sort breaks ties by catalog index exactly like the dense path."""
         scores = scores.copy()
         exclude = set(anchors)
         for b in query.get("blackList") or []:
@@ -256,6 +289,16 @@ class CooccurrenceAlgorithm(TPUAlgorithm):
                 exclude.add(model.item_index[str(b)])
         for j in exclude:
             scores[j] = 0.0
+        if isinstance(scores, Shortlist):
+            order = topk_order(scores.scores, int(query.get("num", 10)))
+            return {
+                "itemScores": [
+                    {"item": model.item_ids[int(scores.indices[j])],
+                     "score": float(scores.scores[j])}
+                    for j in order
+                    if scores.scores[j] > 0
+                ]
+            }
         order = topk_order(scores, int(query.get("num", 10)))
         return {
             "itemScores": [
@@ -269,6 +312,10 @@ class CooccurrenceAlgorithm(TPUAlgorithm):
         anchors = self._resolve_anchors(model, query)
         if not anchors:
             return {"itemScores": []}
+        if self._retrieval.mode == "mips":
+            return self._topk_response(
+                model, self._compact_scores(model, anchors), query, anchors
+            )
         scores = np.zeros(len(model.item_ids), np.float64)
         cols, vals = self._anchor_contributions(model, anchors)
         np.add.at(scores, cols, vals)
@@ -303,6 +350,19 @@ class CooccurrenceAlgorithm(TPUAlgorithm):
         # malformed queries raise predict()'s error BEFORE the vectorized
         # work: one bad query must not cost the batch its completed scoring
         out.extend((qid, self.predict(model, q)) for qid, q in fallback)
+        if self._retrieval.mode == "mips":
+            # compact per-row accumulation: peak score memory is
+            # O(anchors * topK) per row, never the [B, items] buffer below
+            out.extend(
+                (
+                    qid,
+                    self._topk_response(
+                        model, self._compact_scores(model, anchors), q, anchors
+                    ),
+                )
+                for qid, q, anchors in resolved
+            )
+            return out
         n_items = len(model.item_ids)
         # halved: this buffer accumulates in f64 (predict's dtype -- the
         # batched and single paths must sum identically) while

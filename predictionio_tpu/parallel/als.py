@@ -796,9 +796,27 @@ class ALSModel:
     _item_norms: np.ndarray | None = field(
         default=None, init=False, repr=False, compare=False
     )
+    #: lazily-built device retrieval indexes (``ops/mips.RetrievalIndex``),
+    #: keyed by (kind, RetrievalConfig) -- see
+    #: ``models/_als_common.retrieval_index``. Old pickled blobs predate
+    #: this field; readers go through getattr with a default.
+    _retrieval_cache: dict | None = field(
+        default=None, init=False, repr=False, compare=False
+    )
+
+    def __getstate__(self):
+        # device arrays + jitted programs must never enter a model blob:
+        # the registry is the durability path, indexes rebuild at deploy
+        state = self.__dict__.copy()
+        state["_retrieval_cache"] = None
+        return state
 
     def score_items_for_user(self, user_index: int) -> np.ndarray:
-        return self.item_factors @ self.user_factors[user_index]
+        # einsum, not @: BLAS sgemv picks its kernel by matrix height, so a
+        # gathered-row product is a ULP off the full one -- einsum's per-row
+        # reduction is height-independent, which lets the mips shortlist
+        # re-rank (_als_common._host_rerank) reproduce these scores bitwise
+        return np.einsum("ik,k->i", self.item_factors, self.user_factors[user_index])
 
     def score_users_for_item(self, item_index: int) -> np.ndarray:
         return self.user_factors @ self.item_factors[item_index]
@@ -810,10 +828,13 @@ class ALSModel:
         return self._item_norms
 
     def similar_items(self, item_index: int) -> np.ndarray:
-        """Cosine scores of all items against one (ALS-space similarity)."""
+        """Cosine scores of all items against one (ALS-space similarity).
+
+        einsum for the same reason as ``score_items_for_user``: the mips
+        shortlist replays this row arithmetic and must land bitwise."""
         v = self.item_factors[item_index]
         norms = self.item_norms * (self.item_norms[item_index] + 1e-12)
-        return (self.item_factors @ v) / np.maximum(norms, 1e-12)
+        return np.einsum("ik,k->i", self.item_factors, v) / np.maximum(norms, 1e-12)
 
 
 def device_put_blocks(side: BucketedCSR, put) -> tuple:

@@ -1,0 +1,439 @@
+"""Two-stage quantized MIPS retrieval: quantization contracts, kernel
+parity in interpret mode, and scan-vs-mips serving parity.
+
+The load-bearing claims (ISSUE 16):
+
+- ``ops/quantize``: symmetric per-block int8 round-trip error is bounded
+  by ``scale / 2`` element-wise and ``(scale / 2) * ||q||_1`` per score.
+- ``ops/mips``: stage 1 emits exactly each tile's top-R quantized
+  scores/indices; stage 2 returns an ascending shortlist whose exact
+  scores match the f32 matmul; when the shortlist covers the catalog the
+  mips response ranks identically to the full scan INCLUDING tie order
+  (ascending shortlist indices -> stable sort ties break by catalog
+  index), batched and unbatched.
+- ``models/_als_common``: the seen/blackList filters write through a
+  ``Shortlist`` exactly like a dense score vector.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models._als_common import (
+    Shortlist,
+    batch_score_known_users,
+    build_seen,
+    score_known_user,
+    similar_item_scores,
+    topk_item_scores,
+)
+from predictionio_tpu.ops.mips import (
+    RetrievalConfig,
+    RetrievalIndex,
+    mips_block_topk,
+    mips_bytes,
+    reference_shortlist,
+    scan_bytes,
+)
+from predictionio_tpu.ops.quantize import (
+    pack_int8_blockwise,
+    quantization_error_bound,
+    score_error_bound,
+    unpack_blockwise,
+)
+from predictionio_tpu.parallel.als import ALSModel
+
+
+def _factors(num_items, k=16, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((num_items, k)) * scale).astype(np.float32)
+
+
+class TestQuantize:
+    def test_round_trip_error_bound(self):
+        f = _factors(300, seed=1) * np.linspace(0.1, 3.0, 300)[:, None].astype(
+            np.float32
+        )
+        packed = pack_int8_blockwise(f, block_items=64)
+        assert packed.num_items == 300
+        assert packed.q.shape == (320, 16)  # padded to the block multiple
+        assert packed.num_blocks == 5
+        deq = unpack_blockwise(packed)
+        assert deq.shape == f.shape
+        err = np.abs(f - deq).reshape(-1)
+        bound = np.repeat(quantization_error_bound(packed), 64)[:300]
+        per_row = np.abs(f - deq).max(axis=1)
+        assert (per_row <= bound * (1 + 1e-6)).all()
+        assert err.max() > 0  # actually quantized, not a copy
+
+    def test_padding_rows_are_zero(self):
+        packed = pack_int8_blockwise(_factors(10), block_items=64)
+        assert packed.q.shape[0] == 64
+        assert (packed.q[10:] == 0).all()
+
+    def test_all_zero_block_scale_one(self):
+        packed = pack_int8_blockwise(np.zeros((16, 4), np.float32), block_items=8)
+        assert (packed.scales == 1.0).all()
+        assert (unpack_blockwise(packed) == 0).all()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            pack_int8_blockwise(np.zeros((4, 4, 4), np.float32))
+        with pytest.raises(ValueError):
+            pack_int8_blockwise(np.zeros((4, 4), np.float32), block_items=12)
+        with pytest.raises(ValueError):
+            pack_int8_blockwise(np.zeros((4, 4), np.float32), block_items=0)
+
+    def test_score_error_bound(self):
+        f = _factors(128, seed=2)
+        packed = pack_int8_blockwise(f, block_items=64)
+        deq = unpack_blockwise(packed)
+        rng = np.random.default_rng(3)
+        for _ in range(5):
+            q = rng.standard_normal(16).astype(np.float32)
+            err = np.abs(f @ q - deq @ q)
+            bound = np.repeat(score_error_bound(packed, q), 64)[:128]
+            assert (err <= bound * (1 + 1e-5)).all()
+
+
+class TestKernelParity:
+    """mips_block_topk (interpret mode) vs a numpy per-tile reference."""
+
+    def test_matches_reference(self):
+        f = _factors(96, seed=4)
+        packed = pack_int8_blockwise(f, block_items=32)
+        deq = unpack_blockwise(
+            packed
+        )  # reference scores use the SAME dequantized table
+        deq_padded = packed.q.astype(np.float32) * np.repeat(
+            packed.scales[:, 0], 32
+        )[:, None]
+        q = _factors(8, seed=5)
+        r = 4
+        scores, idx = mips_block_topk(
+            q, packed.q, packed.scales, block_topk=r, interpret=True
+        )
+        assert scores.shape == (8, 3 * r) and idx.shape == (8, 3 * r)
+        ref = q @ deq_padded.T  # [8, 96]
+        for b in range(3):
+            block = ref[:, b * 32 : (b + 1) * 32]
+            order = np.argsort(-block, axis=1, kind="stable")[:, :r]
+            np.testing.assert_array_equal(
+                np.asarray(idx)[:, b * r : (b + 1) * r], order + b * 32
+            )
+            np.testing.assert_allclose(
+                np.asarray(scores)[:, b * r : (b + 1) * r],
+                np.take_along_axis(block, order, axis=1),
+                rtol=1e-5,
+                atol=1e-5,
+            )
+        assert deq.shape == (96, 16)
+
+    def test_tie_breaks_to_lowest_index(self):
+        # duplicated rows INSIDE one tile: the kernel's first-match argmax
+        # must emit the lower catalog index first, like a stable argsort
+        f = np.ones((16, 8), np.float32)
+        packed = pack_int8_blockwise(f, block_items=16)
+        q = np.ones((8, 8), np.float32)
+        scores, idx = mips_block_topk(
+            q, packed.q, packed.scales, block_topk=3, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(idx)[0], [0, 1, 2])
+
+    def test_validation(self):
+        packed = pack_int8_blockwise(_factors(32), block_items=32)
+        with pytest.raises(ValueError):
+            mips_block_topk(
+                _factors(5), packed.q, packed.scales, block_topk=4, interpret=True
+            )
+        with pytest.raises(ValueError):
+            mips_block_topk(
+                _factors(8), packed.q, packed.scales, block_topk=0, interpret=True
+            )
+
+
+class TestSearch:
+    def test_covering_shortlist_matches_exact(self):
+        """shortlist >= catalog: stage 2 must return every live item in
+        ascending order with exact f32 scores, sentinels past the end."""
+        f = _factors(100, seed=6)
+        config = RetrievalConfig(
+            mode="mips", shortlist=128, block_items=64, block_topk=64
+        )
+        index = RetrievalIndex(f, config)
+        q = _factors(3, seed=7)
+        idx, scores = index.search(q)
+        assert idx.shape == (3, 128)
+        exact = q @ f.T
+        for row in range(3):
+            live = idx[row] < 100
+            assert live.sum() == 100
+            np.testing.assert_array_equal(idx[row][live], np.arange(100))
+            np.testing.assert_allclose(
+                scores[row][live], exact[row], rtol=1e-5, atol=1e-5
+            )
+            assert (idx[row][~live] == 100).all()
+            assert np.isneginf(scores[row][~live]).all()
+
+    def test_indices_ascending(self):
+        index = RetrievalIndex(
+            _factors(500, seed=8),
+            RetrievalConfig(mode="mips", shortlist=64, block_items=64, block_topk=16),
+        )
+        idx, _ = index.search(_factors(4, seed=9))
+        assert (np.diff(idx.astype(np.int64), axis=1) > 0).all()
+
+    def test_recall_with_margin(self):
+        """The oversampled shortlist absorbs quantization reorderings:
+        recall@10 vs the exact scan is 1.0 at these shapes (the bench
+        measures the same at 1M items)."""
+        f = _factors(2000, seed=10)
+        index = RetrievalIndex(
+            f,
+            RetrievalConfig(
+                mode="mips", shortlist=256, block_items=128, block_topk=32
+            ),
+        )
+        q = _factors(16, seed=11)
+        idx, _ = index.search(q)
+        exact = q @ f.T
+        true_top = np.argsort(-exact, axis=1, kind="stable")[:, :10]
+        hits = sum(
+            len(set(true_top[r].tolist()) & set(idx[r].tolist()))
+            for r in range(16)
+        )
+        assert hits / (16 * 10) >= 0.99
+
+    def test_single_query_and_padding(self):
+        index = RetrievalIndex(
+            _factors(64, seed=12),
+            RetrievalConfig(mode="mips", shortlist=32, block_items=32, block_topk=32),
+        )
+        idx1, s1 = index.search(_factors(1, seed=13)[0])  # 1-D query works
+        idx5, s5 = index.search(
+            np.concatenate([_factors(1, seed=13), _factors(4, seed=14)])
+        )
+        assert idx1.shape == (1, 32)
+        np.testing.assert_array_equal(idx1[0], idx5[0])
+        np.testing.assert_allclose(s1[0], s5[0], rtol=1e-6)
+
+
+class TestRetrievalConfig:
+    def test_defaults_and_parse(self):
+        assert RetrievalConfig.from_params(None).mode == "scan"
+        assert RetrievalConfig.from_params({}).mode == "scan"
+        conf = RetrievalConfig.from_params(
+            {"mode": "mips", "shortlist": 64, "blockItems": 128, "blockTopk": 8}
+        )
+        assert (conf.shortlist, conf.block_items, conf.block_topk) == (64, 128, 8)
+
+    def test_rejections(self):
+        with pytest.raises(ValueError, match="scan"):
+            RetrievalConfig(mode="turbo")
+        with pytest.raises(ValueError, match="unknown retrieval"):
+            RetrievalConfig.from_params({"mode": "mips", "shortList": 9})
+        with pytest.raises(ValueError, match="object"):
+            RetrievalConfig.from_params("mips")
+        with pytest.raises(ValueError):
+            RetrievalConfig(shortlist=0)
+        with pytest.raises(ValueError):
+            RetrievalConfig(block_topk=0)
+
+
+MIPS_ALL = RetrievalConfig(
+    # shortlist covers the whole catalog under test: mips must then rank
+    # identically to the scan, tie order included
+    mode="mips", shortlist=256, block_items=64, block_topk=64
+)
+
+
+def _als(num_items=120, num_users=6, k=16, seed=20, with_ties=False):
+    f = _factors(num_items, k=k, seed=seed)
+    if with_ties:
+        f[40] = f[7]  # duplicated rows: exact score ties across tiles
+        f[80] = f[7]
+    return ALSModel(
+        user_factors=_factors(num_users, k=k, seed=seed + 1),
+        item_factors=f,
+    )
+
+
+class TestServingParity:
+    def test_scan_vs_mips_rank_identically(self):
+        als = _als(with_ties=True)
+        ids = [f"i{j}" for j in range(120)]
+        for u in range(6):
+            dense = score_known_user(als, u)
+            short = score_known_user(als, u, MIPS_ALL)
+            assert isinstance(short, Shortlist) and short.shape == (120,)
+            a = topk_item_scores(ids, dense, 12)
+            b = topk_item_scores(ids, short, 12)
+            # byte-identical, scores included: the host re-rank runs the
+            # same gathered-row BLAS matvec as the scan path, so even
+            # ULP-separated near-ties order identically
+            assert a == b, f"user {u} mips response != scan response"
+
+    def test_batched_matches_unbatched(self):
+        als = _als(with_ties=True)
+        ids = [f"i{j}" for j in range(120)]
+        rows = [(f"q{u}", {"num": 10}, u) for u in range(6)]
+        batched = batch_score_known_users(
+            als,
+            rows,
+            lambda scores, qid, q, user_idx: (qid, topk_item_scores(ids, scores, 10)),
+            retrieval=MIPS_ALL,
+        )
+        for (qid, resp), u in zip(batched, range(6)):
+            single = topk_item_scores(ids, score_known_user(als, u, MIPS_ALL), 10)
+            assert resp == single, f"user {u} batched != unbatched"
+
+    def test_seen_filter_applies_before_formatting(self):
+        als = _als()
+        ids = [f"i{j}" for j in range(120)]
+        short = score_known_user(als, 0, MIPS_ALL)
+        top = topk_item_scores(ids, short.copy(), 5)["itemScores"]
+        banned = int(top[0]["item"][1:])
+        short[banned] = -np.inf
+        refiltered = topk_item_scores(ids, short, 5)["itemScores"]
+        assert all(s["item"] != f"i{banned}" for s in refiltered)
+        # filtering an index OUTSIDE the shortlist is a silent no-op
+        short[banned] = -np.inf  # idempotent
+        outside = Shortlist(np.array([2, 5]), np.array([1.0, 2.0]), 10)
+        outside[3] = -np.inf
+        np.testing.assert_array_equal(outside.scores, [1.0, 2.0])
+
+    def test_where_allowed_masks_compactly(self):
+        short = Shortlist(np.array([1, 4, 7]), np.array([3.0, 2.0, 1.0]), 10)
+        allowed = np.zeros(10, bool)
+        allowed[[4, 9]] = True
+        short.where_allowed(allowed)
+        np.testing.assert_array_equal(short.scores, [-np.inf, 2.0, -np.inf])
+
+    def test_similar_items_parity(self):
+        als = _als()
+        ids = [f"i{j}" for j in range(120)]
+        anchors = [3, 17, 44]
+        dense = similar_item_scores(als, anchors)
+        short = similar_item_scores(als, anchors, MIPS_ALL)
+        assert isinstance(short, Shortlist)
+        # the shortlist re-ranks by replaying scan's per-anchor cosine
+        # arithmetic on the gathered rows: responses match bitwise
+        assert topk_item_scores(ids, dense, 10) == topk_item_scores(ids, short, 10)
+
+    def test_index_cached_and_unpickled(self):
+        import pickle
+
+        als = _als()
+        score_known_user(als, 0, MIPS_ALL)
+        assert als._retrieval_cache and ("dot", MIPS_ALL) in als._retrieval_cache
+        blob = pickle.dumps(als)
+        revived = pickle.loads(blob)
+        assert revived._retrieval_cache is None  # device state never pickles
+        # and rebuilding on the revived model serves the same response
+        a = topk_item_scores([str(j) for j in range(120)],
+                             score_known_user(als, 1, MIPS_ALL), 8)
+        b = topk_item_scores([str(j) for j in range(120)],
+                             score_known_user(revived, 1, MIPS_ALL), 8)
+        assert [s["item"] for s in a["itemScores"]] == [
+            s["item"] for s in b["itemScores"]
+        ]
+
+
+class TestCooccurrenceCompactPath:
+    def test_similarproduct_mips_matches_scan(self):
+        """The cooccurrence template's mips mode (compact groupby of the
+        anchors' indicator entries) answers identically to the dense
+        buffer -- it is exact by construction."""
+        from predictionio_tpu.controller.base import Params
+        from predictionio_tpu.models.similarproduct.engine import (
+            CooccurrenceAlgorithm,
+            SimilarityModel,
+        )
+
+        rng = np.random.default_rng(70)
+        n_items, k = 60, 8
+        top_idx = np.stack(
+            [rng.choice(n_items, k, replace=False) for _ in range(n_items)]
+        )
+        top_val = rng.random((n_items, k)).astype(np.float32)
+        top_val[5, 2] = 0.0  # a non-positive indicator entry drops
+        ids = [f"i{j}" for j in range(n_items)]
+        model = SimilarityModel(
+            item_ids=ids,
+            item_index={i: j for j, i in enumerate(ids)},
+            top_indices=top_idx,
+            top_values=top_val,
+            user_history={"u0": [1, 2, 3]},
+        )
+        scan = CooccurrenceAlgorithm(Params({}))
+        mips = CooccurrenceAlgorithm(Params({"retrieval": {"mode": "mips"}}))
+        queries = [
+            {"items": ["i5", "i9"], "num": 7},
+            {"items": ["i0"], "num": 5, "blackList": ["i9"]},
+            {"user": "u0", "num": 6},
+        ]
+        for q in queries:
+            assert scan.predict(model, q) == mips.predict(model, q)
+        rows = [(f"q{n}", q) for n, q in enumerate(queries)]
+        assert scan.batch_predict(model, rows) == mips.batch_predict(model, rows)
+
+
+class TestBuildSeen:
+    def test_matches_naive_loop(self):
+        rng = np.random.default_rng(30)
+        users = rng.integers(0, 50, 1000)
+        items = rng.integers(0, 200, 1000)
+        naive: dict[int, set[int]] = {}
+        for u, i in zip(users, items):
+            naive.setdefault(int(u), set()).add(int(i))
+        assert build_seen(users, items) == naive
+
+    def test_empty(self):
+        assert build_seen(np.empty(0, np.int64), np.empty(0, np.int64)) == {}
+
+    def test_single_user(self):
+        assert build_seen(np.array([7, 7, 7]), np.array([1, 2, 1])) == {7: {1, 2}}
+
+
+class TestReferenceOracle:
+    def test_reference_matches_kernel_candidates(self):
+        """The numpy reference selects the same shortlist as the jitted
+        two-stage program (ties aside -- random floats don't tie)."""
+        f = _factors(700, seed=50)
+        conf = RetrievalConfig(
+            mode="mips", shortlist=96, block_items=64, block_topk=16
+        )
+        q = _factors(8, seed=51)
+        sel = reference_shortlist(f, q, conf)
+        idx, _ = RetrievalIndex(f, conf).search(q)
+        np.testing.assert_array_equal(sel, idx)
+
+
+@pytest.mark.slow
+class TestMillionItemRecall:
+    def test_recall_at_10_contract(self):
+        """ISSUE 16 acceptance: a 1M-item catalog serves top-10 with
+        recall@10 >= 0.99 at the default retrieval knobs (measured through
+        the reference oracle -- the interpret-mode kernel at this scale
+        would time the interpreter, not the contract)."""
+        rng = np.random.default_rng(60)
+        f = rng.standard_normal((1_000_000, 16)).astype(np.float32)
+        q = rng.standard_normal((16, 16)).astype(np.float32)
+        sel = reference_shortlist(f, q, RetrievalConfig(mode="mips"))
+        exact = q @ f.T
+        true_top = np.argpartition(-exact, 9, axis=1)[:, :10]
+        hits = sum(
+            len(set(true_top[r].tolist()) & set(sel[r].tolist()))
+            for r in range(16)
+        )
+        assert hits / 160 >= 0.99
+
+
+class TestBytesModel:
+    def test_mips_moves_fewer_bytes_at_scale(self):
+        m = mips_bytes(1_000_000, 16, 32)
+        s = scan_bytes(1_000_000, 16, 32)
+        assert m < s / 4  # the whole point of the packed two-stage layout
+
+    def test_models_positive(self):
+        assert mips_bytes(1000, 16, 1) > 0
+        assert scan_bytes(1000, 16, 1) > 0
