@@ -206,8 +206,15 @@ class Shortlist:
 
     def where_allowed(self, allowed: np.ndarray, sentinel=-np.inf) -> "Shortlist":
         """Apply a dense [num_items] bool mask (whiteList/categories)
-        compactly: O(shortlist), never materializing dense scores."""
-        self.scores = np.where(allowed[self.indices], self.scores, sentinel)
+        compactly: O(shortlist), never materializing dense scores.
+
+        ``indices`` may carry ``num_items`` sentinels (search padding,
+        guaranteed on catalogs smaller than the candidate budget) which
+        are out of range for the dense mask -- they clamp to a valid row
+        for the gather and always mask to ``sentinel``."""
+        valid = self.indices < self.num_items
+        safe = np.minimum(self.indices, max(self.num_items - 1, 0))
+        self.scores = np.where(valid & allowed[safe], self.scores, sentinel)
         return self
 
     def copy(self) -> "Shortlist":

@@ -22,10 +22,12 @@ the scan with the ALX-style device-resident layout (arxiv 2112.02194):
   ``topk_item_scores`` tail, so whenever the shortlist contains the true
   top-k the bytes on the wire are identical to scan mode.
 
-Containment contract: a tile's top-R is selected on the QUANTIZED scores,
-so the quantized global top-``min(R, shortlist)`` is always inside the
-candidate set (the global top-k of any score vector is contained in the
-union of per-tile top-k for R >= k). Recall vs the exact scan is then
+Containment contract: a tile's top-R is selected on the QUANTIZED scores
+with padding rows masked below any real score (their zero rows would
+otherwise outrank real negative scores), so the quantized global
+top-``min(R, shortlist)`` is always inside the candidate set (the global
+top-k of any score vector is contained in the union of per-tile top-k
+for R >= k). Recall vs the exact scan is then
 bounded only by quantization reorderings inside the
 ``score_error_bound`` window, which the shortlist margin oversamples
 against -- measured >= 0.99 recall@10 at 1M items with the defaults
@@ -65,9 +67,14 @@ from predictionio_tpu.ops.quantize import (
 BLOCK_QUERIES = 8
 
 #: matches plain_attention/flash_attention's finite masked-score constant:
-#: selection masking stays finite inside the kernel; -inf sentinels are
-#: applied at the (host/XLA) merge where they are cheap and safe
+#: masking stays finite inside the kernel; -inf sentinels are applied at
+#: the (host/XLA) merge where they are cheap and safe. Padding rows mask
+#: to _NEG; already-selected columns mask STRICTLY BELOW it (_SEL), so
+#: once real scores are exhausted the selection drains distinct padding
+#: columns (-> merge sentinels) instead of re-emitting a selected column
+#: as a duplicate candidate with a real catalog index.
 _NEG = -1e30
+_SEL = -2e30
 
 
 def mips_block_topk(
@@ -76,6 +83,7 @@ def mips_block_topk(
     scales,
     *,
     block_topk: int,
+    num_items: int,
     interpret: bool = False,
 ):
     """Stage 1: per-quantization-block top-``block_topk`` candidates.
@@ -83,9 +91,14 @@ def mips_block_topk(
     ``queries`` f32 [B, K] (B a ``BLOCK_QUERIES`` multiple), ``q_table``
     int8 [padded_items, K], ``scales`` f32 [num_blocks, 1]. Returns
     ``(scores [B, num_blocks * R] f32, indices [B, num_blocks * R] i32)``
-    with indices already global catalog indices (padding rows of the last
-    block surface as candidates with score 0 -- the merge masks indices
-    >= num_items before they can reach a shortlist).
+    with indices already global catalog indices. Padding rows of the last
+    block (global index >= ``num_items``) are masked to ``_NEG`` BEFORE
+    the per-tile selection: their dequantized score is exactly 0, which
+    would otherwise outrank real items with negative scores and evict
+    them from the candidate set, breaking the containment contract. They
+    can still surface as candidates when the tile holds fewer than R real
+    rows -- the merge maps any remaining index >= num_items to the
+    ``(num_items, -inf)`` sentinel.
     """
     import jax
     import jax.numpy as jnp
@@ -108,6 +121,10 @@ def mips_block_topk(
     r = block_topk
     if not 0 < r <= bi:
         raise ValueError(f"block_topk {r} must be in [1, {bi}]")
+    if not 0 < num_items <= padded_items:
+        raise ValueError(
+            f"num_items {num_items} must be in [1, {padded_items}]"
+        )
 
     def kernel(
         q_ref,       # VMEM [BB, K] f32
@@ -125,6 +142,9 @@ def mips_block_topk(
         )                                                         # [BB, BI]
         col = jax.lax.broadcasted_iota(jnp.int32, (bb, bi), 1)
         base = pl.program_id(1) * bi
+        # padding rows dequantize to score 0, which would outrank real
+        # negative scores -- mask them below any real score pre-selection
+        s = jnp.where(base + col < num_items, s, _NEG)
         # R unrolled select-and-mask passes (pure VPU: Mosaic has no
         # in-kernel sort); first-match (min index) argmax so ties inside
         # a tile resolve to the lowest catalog index, like argsort
@@ -134,7 +154,7 @@ def mips_block_topk(
             local = jnp.min(jnp.where(hit, col, bi), axis=1)      # [BB]
             score_ref[:, 0, step] = m
             idx_ref[:, 0, step] = base + local
-            s = jnp.where(col == local[:, None], _NEG, s)
+            s = jnp.where(col == local[:, None], _SEL, s)
 
     scores, idx = pl.pallas_call(
         kernel,
@@ -174,7 +194,8 @@ def _search_program(
     import jax.numpy as jnp
 
     cand_s, cand_i = mips_block_topk(
-        queries, q_table, scales, block_topk=block_topk, interpret=interpret
+        queries, q_table, scales,
+        block_topk=block_topk, num_items=num_items, interpret=interpret,
     )
     valid = cand_i < num_items
     cand_s = jnp.where(valid, cand_s, -jnp.inf)
@@ -331,6 +352,9 @@ def reference_shortlist(
     )[:, None]
     qs = np.asarray(queries, np.float32) @ deq.T          # [B, padded]
     b, padded = qs.shape
+    # mirror the kernel: padding rows masked BEFORE per-tile selection,
+    # so they never evict real negative-scored items from the candidates
+    qs = np.where(np.arange(padded)[None, :] < packed.num_items, qs, _NEG)
     nb = packed.num_blocks
     r = min(config.block_topk, config.block_items)
     tiles = qs.reshape(b, nb, config.block_items)

@@ -110,7 +110,7 @@ class TestKernelParity:
         q = _factors(8, seed=5)
         r = 4
         scores, idx = mips_block_topk(
-            q, packed.q, packed.scales, block_topk=r, interpret=True
+            q, packed.q, packed.scales, block_topk=r, num_items=96, interpret=True
         )
         assert scores.shape == (8, 3 * r) and idx.shape == (8, 3 * r)
         ref = q @ deq_padded.T  # [8, 96]
@@ -135,19 +135,56 @@ class TestKernelParity:
         packed = pack_int8_blockwise(f, block_items=16)
         q = np.ones((8, 8), np.float32)
         scores, idx = mips_block_topk(
-            q, packed.q, packed.scales, block_topk=3, interpret=True
+            q, packed.q, packed.scales, block_topk=3, num_items=16, interpret=True
         )
         np.testing.assert_array_equal(np.asarray(idx)[0], [0, 1, 2])
+
+    def test_padding_never_outranks_real_negatives(self):
+        """REVIEW regression: padding rows dequantize to score 0, so an
+        unmasked selection would rank them above every real item with a
+        negative score and evict those items from the candidate set. With
+        the in-kernel mask, all real rows must appear before any padding
+        row whenever R >= the real row count."""
+        rng = np.random.default_rng(90)
+        f = rng.standard_normal((10, 8)).astype(np.float32)
+        packed = pack_int8_blockwise(f, block_items=16)
+        q = rng.standard_normal((8, 8)).astype(np.float32)
+        scores, idx = mips_block_topk(
+            q, packed.q, packed.scales, block_topk=16, num_items=10, interpret=True
+        )
+        idx = np.asarray(idx)
+        for row in range(8):
+            # every real item makes the per-tile top-16, padding fills the
+            # tail -- even for rows where all 10 exact scores are negative
+            assert set(idx[row, :10].tolist()) == set(range(10)), (
+                f"query {row}: real items evicted by padding: {idx[row]}"
+            )
+            # the tail drains DISTINCT padding columns (merge sentinels),
+            # never a duplicate of an already-selected real index
+            assert (idx[row, 10:] >= 10).all()
+            assert len(set(idx[row].tolist())) == 16
 
     def test_validation(self):
         packed = pack_int8_blockwise(_factors(32), block_items=32)
         with pytest.raises(ValueError):
             mips_block_topk(
-                _factors(5), packed.q, packed.scales, block_topk=4, interpret=True
+                _factors(5), packed.q, packed.scales,
+                block_topk=4, num_items=32, interpret=True,
             )
         with pytest.raises(ValueError):
             mips_block_topk(
-                _factors(8), packed.q, packed.scales, block_topk=0, interpret=True
+                _factors(8), packed.q, packed.scales,
+                block_topk=0, num_items=32, interpret=True,
+            )
+        with pytest.raises(ValueError):
+            mips_block_topk(
+                _factors(8), packed.q, packed.scales,
+                block_topk=4, num_items=0, interpret=True,
+            )
+        with pytest.raises(ValueError):
+            mips_block_topk(
+                _factors(8), packed.q, packed.scales,
+                block_topk=4, num_items=33, interpret=True,
             )
 
 
@@ -308,6 +345,24 @@ class TestServingParity:
         short.where_allowed(allowed)
         np.testing.assert_array_equal(short.scores, [-np.inf, 2.0, -np.inf])
 
+    def test_where_allowed_sentinel_safe(self):
+        """REVIEW regression: search pads small catalogs with
+        index == num_items sentinels; the dense mask gather must not
+        index out of bounds, and sentinel slots always mask off."""
+        short = Shortlist(
+            np.array([1, 4, 10, 10]),  # two search-padding sentinels
+            np.array([3.0, 2.0, -np.inf, -np.inf]),
+            10,
+        )
+        short.where_allowed(np.ones(10, bool))
+        np.testing.assert_array_equal(short.scores, [3.0, 2.0, -np.inf, -np.inf])
+        allowed = np.zeros(10, bool)
+        allowed[1] = True
+        short.where_allowed(allowed)
+        np.testing.assert_array_equal(
+            short.scores, [3.0, -np.inf, -np.inf, -np.inf]
+        )
+
     def test_similar_items_parity(self):
         als = _als()
         ids = [f"i{j}" for j in range(120)]
@@ -336,6 +391,91 @@ class TestServingParity:
         assert [s["item"] for s in a["itemScores"]] == [
             s["item"] for s in b["itemScores"]
         ]
+
+
+class TestTinyCatalogParity:
+    """REVIEW regression: catalogs smaller than the candidate budget are
+    GUARANTEED to pad the shortlist with index == num_items sentinels and
+    to put quantization-padding rows in the kernel's selection window --
+    the regime where both review bugs lived. At these sizes the shortlist
+    must still contain every live item, so responses are byte-identical
+    to scan mode, whiteList/categories filters included."""
+
+    # catalog < block_topk < block_items (the defaults), and a two-tile
+    # catalog whose last tile is part padding: both pad-heavy regimes
+    CONFIGS = [
+        (10, RetrievalConfig(mode="mips")),
+        (30, RetrievalConfig(mode="mips", shortlist=32, block_items=16,
+                             block_topk=16)),
+    ]
+
+    @pytest.mark.parametrize("num_items,conf", CONFIGS)
+    def test_shortlist_contains_every_item(self, num_items, conf):
+        als = _als(num_items=num_items, seed=80)
+        for u in range(6):
+            short = score_known_user(als, u, conf)
+            live = short.indices[short.indices < num_items]
+            assert set(live.tolist()) == set(range(num_items)), (
+                f"user {u}: items missing from shortlist: "
+                f"{set(range(num_items)) - set(live.tolist())}"
+            )
+            # the sentinel-bearing regime is actually exercised
+            assert (short.indices == num_items).any()
+            assert np.isneginf(short.scores[short.indices == num_items]).all()
+
+    @pytest.mark.parametrize("num_items,conf", CONFIGS)
+    def test_responses_match_scan_byte_for_byte(self, num_items, conf):
+        als = _als(num_items=num_items, seed=81)
+        # one user whose every score is negative: the padding rows'
+        # unmasked quantized score of 0 would outrank the entire catalog
+        als.user_factors[0] = np.abs(als.user_factors[0])
+        als.item_factors[:] = -np.abs(als.item_factors)
+        als._retrieval_cache = None  # factors changed: drop any index
+        ids = [f"i{j}" for j in range(num_items)]
+        for u in range(6):
+            dense = score_known_user(als, u)
+            assert u != 0 or (dense < 0).all()
+            short = score_known_user(als, u, conf)
+            for num in (5, num_items):
+                assert topk_item_scores(ids, dense, num) == topk_item_scores(
+                    ids, short.copy(), num
+                ), f"user {u} num {num}: mips != scan"
+
+    def test_ecommerce_filters_parity(self):
+        """whiteList/categories queries route through where_allowed with
+        sentinel-bearing shortlists -- the exact crash the review
+        reproduced (IndexError on the dense-mask gather)."""
+        from predictionio_tpu.controller.base import Params
+        from predictionio_tpu.models.ecommerce.engine import (
+            ECommAlgorithm,
+            ECommerceModel,
+        )
+
+        als = _als(num_items=10, seed=82)
+        ids = [f"i{j}" for j in range(10)]
+        model = ECommerceModel(
+            als=als,
+            app_name="",  # no event store: pure factor serving
+            user_index={f"u{k}": k for k in range(6)},
+            item_ids=ids,
+            item_index={i: j for j, i in enumerate(ids)},
+            seen={0: {4}},
+            category_items={"c0": np.asarray([1, 3, 5], np.int64)},
+            similar_events=["view"],
+            seen_mode="model",
+        )
+        scan = ECommAlgorithm(Params({}))
+        mips = ECommAlgorithm(Params({"retrieval": {"mode": "mips"}}))
+        queries = [
+            {"user": "u0", "num": 10, "whiteList": ["i2", "i6", "i7"]},
+            {"user": "u1", "num": 10, "categories": ["c0"]},
+            {"user": "u2", "num": 10, "whiteList": ["i1"], "categories": ["c0"]},
+            {"user": "u3", "num": 10},
+        ]
+        for q in queries:
+            assert scan.predict(model, q) == mips.predict(model, q), q
+        rows = [(f"q{n}", q) for n, q in enumerate(queries)]
+        assert scan.batch_predict(model, rows) == mips.batch_predict(model, rows)
 
 
 class TestCooccurrenceCompactPath:
