@@ -883,7 +883,40 @@ def secondary_main(result_path: str) -> None:
             out["streamed_vs_resident_eps"] = rep["streamed_vs_resident_eps"]
         return out
 
+    def eval_quality():
+        """#15: offline replay evaluation as a standing quality gate --
+        `pio eval --replay` on a seeded clique-structured stream:
+        eval_ndcg_at_10 / eval_hit_rate_at_10 are the ranking-quality
+        trend lines (a speed PR that quietly degrades recommendations
+        moves a committed metric), and mips_recall_at_10 /
+        response_identity_rate are the scan-vs-mips retrieval guard on
+        the same model and split (1.0 / 1.0 at the default shortlist is
+        the contract). CPU-only like serving_qps (toy shapes; the eval
+        pass is one batched scorer call either way). Full-size knobs:
+        `python -m predictionio_tpu.tools.eval_bench`."""
+        if tpu:
+            return {
+                "skipped": "CPU-only phase (TPU child shares an already-"
+                "initialized backend)"
+            }
+        from predictionio_tpu.tools.eval_bench import run_eval_quality
+
+        rep = run_eval_quality(
+            events=3_000, users=60, items=128, rank=8, iterations=3,
+        )
+        return {
+            "eval_ndcg_at_10": rep["eval_ndcg_at_10"],
+            "eval_hit_rate_at_10": rep["eval_hit_rate_at_10"],
+            "mips_recall_at_10": rep["mips_recall_at_10"],
+            "response_identity_rate": rep["response_identity_rate"],
+            "eval_holdout_users": rep["holdout_users"],
+            "replay_seconds": rep["replay_seconds"],
+            "config": "#15 eval_quality (3k events, 60 users, 128 items,"
+            " rank 8, split 0.8, k 10, sqlite)",
+        }
+
     phase("naive_bayes_fit", nb_fit)
+    phase("eval_quality", eval_quality)
     phase("logreg_lbfgs_fit", logreg_fit)
     phase("cooccurrence_llr_indicators", cooc_indicators)
     phase("ncf_batchpredict", ncf_batchpredict)

@@ -94,6 +94,17 @@ class DataSource(Component, Generic[TD, Q, A]):
             "evaluation is unavailable for this engine"
         )
 
+    def read_replay(self, ctx, spec):
+        """Time-travel replay split (``pio eval --replay``): train on
+        events strictly before the boundary, hold out interactions
+        at-or-after it. ``spec`` is an ``eval.split.SplitSpec``; returns
+        an ``eval.split.ReplayFold`` whose pairs are per-held-out-user
+        ``(query, [actual item ids])``. Default: unsupported."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement read_replay; "
+            "`pio eval --replay` is unavailable for this engine"
+        )
+
     def online_handle(self):
         """Describe this datasource's interaction scan for the
         continuous-learning loop (``pio retrain --follow``): a

@@ -1,0 +1,220 @@
+"""Offline replay evaluation: the `pio eval --replay` core.
+
+Replays a time-bounded event prefix through the DASE hooks: the
+datasource's ``read_replay`` cuts the timeline (train ``< t``, holdout
+``>= t`` -- ``eval.split``), the algorithm trains on the prefix (or a
+pinned registry generation is rehydrated instead), EVERY held-out user
+is scored through the template's vectorized ``batch_predict`` in one
+pass, and the ranked lists reduce to hit-rate@k / NDCG@k / MRR /
+recall@k (``eval.metrics``). Seen-filtering matches live serving
+semantics: the fold's training data carries the ``eval_fold`` flag, so
+templates downgrade live event-store filtering to the trained-in map
+exactly as the k-fold evaluator does (a live read would see the held-out
+events themselves and score every actual item -inf).
+
+The report also carries the standing retrieval guard PR 16 queued: the
+scan and mips arms re-rank the same split with the same model, reporting
+shortlist recall@k and the response byte-identity rate -- the accuracy
+trip-wire for every future speed PR.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+from predictionio_tpu.eval.metrics import ranking_metrics, select_metrics
+from predictionio_tpu.eval.split import ReplayFold, SplitSpec
+
+logger = logging.getLogger("pio.eval")
+
+
+def _serve_all(engine, engine_params, algorithms, models, pairs):
+    """One batched pass: every algorithm's ``batch_predict`` over the
+    whole holdout, combined per query by the engine's Serving component
+    (the exact live /queries.json combination step)."""
+    serving = engine.serving(engine_params)
+    indexed = [(qid, q) for qid, (q, _) in enumerate(pairs)]
+    per_algo = [
+        dict(a.batch_predict(m, indexed)) for a, m in zip(algorithms, models)
+    ]
+    return [
+        serving.serve(q, [pa[qid] for pa in per_algo])
+        for qid, (q, _) in enumerate(pairs)
+    ]
+
+
+def _ranked_ids(response: Any, k: int) -> list[str]:
+    """A served response -> its ranked item ids (responses lacking
+    ``itemScores`` rank nothing, i.e. score as a total miss)."""
+    if not isinstance(response, dict):
+        return []
+    return [s["item"] for s in response.get("itemScores") or []][:k]
+
+
+def _load_registry_models(engine, variant, ctx, model_version, registry_dir):
+    """Rehydrate a pinned registry generation -- the `pio deploy
+    --model-version` resolution path, so eval lineage names the exact
+    bytes a rollback would serve. Raises ``RegistryError`` verbatim on a
+    missing/GC'd/corrupt version."""
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.online.registry import ModelRegistry
+    from predictionio_tpu.workflow.core_workflow import (
+        engine_params_from_instance,
+        resolve_engine_instance,
+    )
+
+    registry = ModelRegistry.for_variant(variant, registry_dir=registry_dir)
+    entry = registry.get(int(model_version))
+    blob = entry.load_blob()  # CRC-verified
+    params_obj = entry.engine_params_obj
+    engine_params = (
+        EngineParams.from_json_obj(params_obj)
+        if params_obj
+        else engine_params_from_instance(
+            resolve_engine_instance(variant, entry.instance_id or None)
+        )
+    )
+    models = engine.prepare_deploy(
+        ctx, engine_params, entry.instance_id or "", blob
+    )
+    lineage = {
+        "source": "registry",
+        "model_version": entry.version,
+        "registry_source": entry.source,
+        "instance_id": entry.instance_id or None,
+        "registry_dir": registry.dir,
+    }
+    return engine_params, models, lineage
+
+
+def _retrieval_guard(engine, engine_params, models, pairs, k) -> dict | None:
+    """Scan-vs-mips A/B on the SAME model and split: shortlist recall@k
+    (overlap of the mips top-k with the scan top-k) and the response
+    byte-identity rate. None when the primary algorithm has no
+    retrieval surface (e.g. NCF's jitted MLP scorer)."""
+    algo_name, algo_params = engine_params.algorithm_params_list[0]
+    algo_cls = engine.algorithm_class_map.get(algo_name)
+    if algo_cls is None or not hasattr(algo_cls, "_retrieval"):
+        return None
+    arms = {}
+    for mode in ("scan", "mips"):
+        params = dict(algo_params)
+        retrieval = dict(params.get("retrieval") or {})
+        retrieval["mode"] = mode
+        params["retrieval"] = retrieval
+        arm_algo = algo_cls(params)
+        indexed = [(qid, q) for qid, (q, _) in enumerate(pairs)]
+        arms[mode] = dict(arm_algo.batch_predict(models[0], indexed))
+        if mode == "mips":
+            shortlist = int(arm_algo._retrieval.shortlist)
+    overlaps, identical, compared = [], 0, 0
+    for qid in range(len(pairs)):
+        scan_ids = _ranked_ids(arms["scan"][qid], k)
+        mips_ids = _ranked_ids(arms["mips"][qid], k)
+        if not scan_ids:
+            continue  # nothing to retrieve for this user in either arm
+        compared += 1
+        overlaps.append(len(set(scan_ids) & set(mips_ids)) / len(scan_ids))
+        if json.dumps(arms["scan"][qid], sort_keys=True) == json.dumps(
+            arms["mips"][qid], sort_keys=True
+        ):
+            identical += 1
+    return {
+        f"shortlist_recall_at_{k}": (
+            round(sum(overlaps) / len(overlaps), 6) if overlaps else None
+        ),
+        "response_identity_rate": (
+            round(identical / compared, 6) if compared else None
+        ),
+        "users_compared": compared,
+        "shortlist": shortlist,
+    }
+
+
+def run_replay_eval(
+    variant,
+    *,
+    split_time: str | None = None,
+    split_frac: float | None = None,
+    k: int = 10,
+    metrics=None,
+    model_version: int | None = None,
+    registry_dir: str | None = None,
+    retrieval_guard: bool = True,
+    engine=None,
+    include_responses: bool = False,
+) -> dict:
+    """Run one replay evaluation; returns the JSON-able report.
+
+    Without ``model_version`` the algorithm trains on the prefix
+    in-process (no instance row, no model blob -- evaluation owns no
+    persistence side effects); with it, the pinned registry generation
+    is rehydrated and scored against the same holdout, and the report's
+    lineage block names the manifest it came from.
+
+    Raises ``ValueError`` (bad spec / unknown metric / empty prefix),
+    ``NotImplementedError`` (datasource without ``read_replay``), or
+    ``online.registry.RegistryError`` (missing/corrupt pinned version);
+    the CLI maps each onto the exit-2 contract.
+    """
+    from predictionio_tpu.workflow.context import RuntimeContext
+    from predictionio_tpu.workflow.json_extractor import build_engine
+
+    names = select_metrics(metrics)
+    if split_time is None and split_frac is None:
+        split_frac = 0.8
+    spec = SplitSpec(split_time=split_time, split_frac=split_frac, k=int(k))
+    spec.validate()
+    engine = engine or build_engine(variant)
+    engine_params = variant.engine_params
+    ctx = RuntimeContext(variant.runtime_conf)
+
+    data_source = engine.data_source_class(engine_params.data_source_params)
+    fold: ReplayFold = data_source.read_replay(ctx, spec)
+    pairs = fold.pairs
+
+    if model_version is not None:
+        engine_params, models, lineage = _load_registry_models(
+            engine, variant, ctx, model_version, registry_dir
+        )
+        algorithms = engine._algorithms(engine_params)
+    else:
+        engine._maybe_sanity_check("replay training data", fold.train_data, False)
+        preparator = engine.preparator_class(engine_params.preparator_params)
+        prepared = preparator.prepare(ctx, fold.train_data)
+        algorithms = engine._algorithms(engine_params)
+        models = [a.train(ctx, prepared) for a in algorithms]
+        lineage = {"source": "replay-train", "model_version": None,
+                   "instance_id": None}
+
+    responses = _serve_all(engine, engine_params, algorithms, models, pairs)
+    predicted = [_ranked_ids(r, spec.k) for r in responses]
+    actual = [a for _, a in pairs]
+    values = ranking_metrics(predicted, actual, spec.k, names)
+
+    guard = None
+    if retrieval_guard:
+        guard = _retrieval_guard(engine, engine_params, models, pairs, spec.k)
+
+    def _key(name: str) -> str:
+        return "mrr" if name == "mrr" else f"{name}_at_{spec.k}"
+
+    report = {
+        "engine": variant.variant_id,
+        "engine_variant": variant.path,
+        "k": spec.k,
+        "metrics": {
+            _key(n): (round(v, 6) if v is not None else None)
+            for n, v in values.items()
+        },
+        "split": fold.bounds.to_json_obj() if fold.bounds else None,
+        "model": lineage,
+        "retrieval_guard": guard,
+    }
+    if include_responses:
+        report["responses"] = responses
+        report["actual"] = [list(map(str, a)) for a in actual]
+        report["queries"] = [q for q, _ in pairs]
+    return report

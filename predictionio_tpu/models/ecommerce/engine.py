@@ -207,6 +207,35 @@ class ECommerceDataSource(DataSource):
         ]
         return [(train, EvalInfo(fold=0), pairs)]
 
+    def read_replay(self, ctx, spec):
+        """Time-travel replay fold (``pio eval --replay``): implicit
+        interactions strictly before the boundary train the fold's model
+        (array-backed, so the trained-in seen map covers exactly the
+        prefix -- live-serving filter parity without seeing the held-out
+        events); each held-out user asks for their top-``spec.k``."""
+        from predictionio_tpu.eval.split import ReplayFold, split_interactions
+
+        data = self._read()
+        cut = split_interactions(data.users, data.items, data.times, spec)
+        train = ECommerceData(
+            users=data.users[cut.train_mask],
+            items=data.items[cut.train_mask],
+            weights=data.weights[cut.train_mask],
+            times=data.times[cut.train_mask],
+            user_ids=data.user_ids,
+            item_ids=data.item_ids,
+            app_name=data.app_name,
+            categories=data.categories,
+        )
+        pairs = [
+            (
+                {"user": data.user_ids[u], "num": spec.k},
+                [data.item_ids[int(i)] for i in items],
+            )
+            for u, items in cut.holdout.items()
+        ]
+        return ReplayFold(train, pairs, cut.bounds)
+
 
 class ECommercePreparator(Preparator):
     """Packs interactions into mesh-sized padded CSR blocks (ALX layout).

@@ -320,6 +320,10 @@ class NCFAlgorithm(TPUAlgorithm):
 
 
 def engine_factory() -> Engine:
+    # NCF shares RecommendationDataSource, so it inherits the time-travel
+    # replay hook (read_replay) and works with `pio eval --replay` as-is:
+    # the replay fold is a RatingsData slice, which NCFPreparator re-reads
+    # with implicit weights exactly like the train path.
     return Engine(
         data_source_class=RecommendationDataSource,
         preparator_class=NCFPreparator,

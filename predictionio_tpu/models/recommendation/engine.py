@@ -170,6 +170,37 @@ class RecommendationDataSource(DataSource):
             out.append((train, EvalInfo(fold=f), pairs))
         return out
 
+    def read_replay(self, ctx, spec):
+        """Time-travel replay fold (``pio eval --replay``): train on
+        ratings strictly before the boundary, ask for each held-out
+        user's top-``spec.k`` (cold holdout users -- no training events
+        -- stay in the fold and score as misses). The fold carries
+        ``eval_fold=True`` so a ``seenFilter: "live"`` variant downgrades
+        to the trained-in map, exactly like the k-fold path."""
+        from predictionio_tpu.eval.split import ReplayFold, split_interactions
+
+        data = self._read()
+        cut = split_interactions(data.users, data.items, data.times, spec)
+        train = RatingsData(
+            users=data.users[cut.train_mask],
+            items=data.items[cut.train_mask],
+            ratings=data.ratings[cut.train_mask],
+            times=data.times[cut.train_mask],
+            user_ids=data.user_ids,
+            item_ids=data.item_ids,
+            app_name=data.app_name,
+            event_names=data.event_names,
+            eval_fold=True,
+        )
+        pairs = [
+            (
+                {"user": data.user_ids[u], "num": spec.k},
+                [data.item_ids[int(i)] for i in items],
+            )
+            for u, items in cut.holdout.items()
+        ]
+        return ReplayFold(train, pairs, cut.bounds)
+
 
 class RecommendationPreparator(Preparator):
     """Packs COO ratings into padded CSR blocks sized for the mesh.

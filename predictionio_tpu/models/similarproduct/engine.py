@@ -113,6 +113,44 @@ class SimilarProductDataSource(DataSource):
         )
         return [(train, EvalInfo(fold=0), pairs)]
 
+    def read_replay(self, ctx, spec):
+        """Time-travel replay fold (``pio eval --replay``): the
+        cooccurrence model trains on interactions strictly before the
+        boundary; each held-out user's query anchors on their TRAINING
+        prefix items only (anchoring on held-out events would both leak
+        the future and self-exclude the actuals). Users with no prefix
+        history stay in the fold with an empty anchor list and score as
+        misses -- the honest cold-user accounting."""
+        from predictionio_tpu.eval.split import ReplayFold, split_interactions
+
+        data = self._read()
+        cut = split_interactions(data.users, data.items, data.times, spec)
+        train = InteractionData(
+            users=data.users[cut.train_mask],
+            items=data.items[cut.train_mask],
+            times=data.times[cut.train_mask],
+            user_ids=data.user_ids,
+            item_ids=data.item_ids,
+        )
+        history: dict[int, list[int]] = {}
+        for u, i in zip(train.users.tolist(), train.items.tolist()):
+            hist = history.setdefault(int(u), [])
+            if int(i) not in hist:
+                hist.append(int(i))
+        pairs = [
+            (
+                {
+                    "items": [
+                        data.item_ids[j] for j in history.get(int(u), [])
+                    ],
+                    "num": spec.k,
+                },
+                [data.item_ids[int(i)] for i in items],
+            )
+            for u, items in cut.holdout.items()
+        ]
+        return ReplayFold(train, pairs, cut.bounds)
+
 
 @dataclass
 class SimilarityModel:

@@ -189,9 +189,32 @@ def _search_program(
     num_items: int,
     interpret: bool,
 ):
-    """Stage 1 + merge + stage-2 exact re-rank, one jitted program."""
+    """Stage 1 + merge + stage-2 exact re-rank, one jitted program.
+
+    When the whole catalog fits the stage-2 budget (``num_items <=
+    shortlist``) stage 1 is skipped entirely: the shortlist IS the
+    catalog and retrieval is exact by construction. Without this
+    degeneration, tiny catalogs inherit stage 1's per-block candidate
+    cap (``num_blocks * block_topk``, e.g. 16 for a single-block
+    catalog), and a query whose seen/blackList filters eat into those
+    candidates comes back short -- the replay eval's scan-vs-mips guard
+    caught exactly that.
+    """
     import jax
     import jax.numpy as jnp
+
+    if num_items <= shortlist:
+        width = min(shortlist, q_table.shape[0])
+        base = jnp.arange(width, dtype=jnp.int32)
+        sel = jnp.where(base < num_items, base, num_items)
+        sel = jnp.broadcast_to(sel, (queries.shape[0], width))
+        gathered = table_f32[jnp.clip(sel, 0, num_items - 1)]
+        exact = jnp.einsum(
+            "bk,bsk->bs", queries, gathered,
+            preferred_element_type=jnp.float32,
+        )
+        exact = jnp.where(sel < num_items, exact, -jnp.inf)
+        return sel, exact
 
     cand_s, cand_i = mips_block_topk(
         queries, q_table, scales,
@@ -224,7 +247,9 @@ class RetrievalConfig:
     query -- the recall margin over ``num``; ``block_items`` the
     quantization/tile granularity; ``block_topk`` the per-tile candidates
     (must stay >= the largest ``num`` served for the containment
-    contract).
+    contract). Catalogs no larger than ``shortlist`` skip stage 1 and
+    retrieve exactly (the shortlist is the catalog), so the containment
+    caveats only bind past that size.
     """
 
     mode: str = "scan"
@@ -347,6 +372,15 @@ def reference_shortlist(
     packed = pack_int8_blockwise(
         np.asarray(factors, np.float32), config.block_items
     )
+    if packed.num_items <= config.shortlist:
+        # mirror the program's exhaustive degeneration: the shortlist is
+        # the catalog (sentinels normalized to num_items, like search)
+        width = min(config.shortlist, packed.q.shape[0])
+        base = np.arange(width, dtype=np.int32)
+        sel = np.where(base < packed.num_items, base, packed.num_items)
+        return np.broadcast_to(
+            sel, (np.atleast_2d(queries).shape[0], width)
+        ).copy()
     deq = packed.q.astype(np.float32) * np.repeat(
         packed.scales[:, 0], config.block_items
     )[:, None]

@@ -222,12 +222,72 @@ def register(sub: argparse._SubParsersAction) -> None:
                           help="server was deployed with --ssl-cert")
     undeploy.set_defaults(func=cmd_undeploy)
 
-    ev = sub.add_parser("eval", help="run an evaluation")
-    ev.add_argument("evaluation", help="dotted path to an Evaluation object/callable")
+    ev = sub.add_parser(
+        "eval",
+        help="run an evaluation (dotted Evaluation, or --replay for the"
+        " time-travel offline replay harness)",
+    )
+    ev.add_argument(
+        "evaluation", nargs="?", default=None,
+        help="dotted path to an Evaluation object/callable (omit with --replay)",
+    )
     ev.add_argument("paramsgen", nargs="?", default=None,
                     help="dotted path to an EngineParamsGenerator")
     ev.add_argument("--engine-dir", default=".")
+    ev.add_argument(
+        "--variant", default=None,
+        help="engine variant JSON for --replay (default engine.json)",
+    )
     ev.add_argument("--output-path", default=None, help="also write results JSON here")
+    ev.add_argument(
+        "--replay", action="store_true",
+        help="offline replay evaluation: cut the event timeline at a"
+        " boundary, train on the prefix (or pin a registry version),"
+        " score every held-out user in one batched pass, report ranking"
+        " metrics + the scan-vs-mips retrieval guard as JSON",
+    )
+    ev.add_argument(
+        "--split-time", default=None, metavar="ISO8601",
+        help="replay boundary: train < t, holdout >= t (e.g."
+        " 2024-03-01T00:00:00Z; naive times are UTC, same parse as event"
+        " ingestion so the cut is microsecond-exact)",
+    )
+    ev.add_argument(
+        "--split-frac", type=float, default=None, metavar="F",
+        help="replay boundary as a fraction of the time-sorted event"
+        " stream (0 < F < 1); resolves to a concrete event timestamp so"
+        " the split is replayable (default 0.8 when --split-time absent)",
+    )
+    ev.add_argument("--k", type=int, default=10,
+                    help="ranking cutoff for metrics and queries (default 10)")
+    ev.add_argument(
+        "--metrics", default=None,
+        help="comma-separated metric names (default: all; see the metric"
+        " catalog in the unknown-metric error or docs/evaluation.md)",
+    )
+    ev.add_argument(
+        "--model-version", type=int, default=None, metavar="N",
+        help="evaluate an exact model-registry version (what `pio deploy"
+        " --model-version N` would serve) instead of training on the"
+        " prefix; the report's model block carries its lineage",
+    )
+    ev.add_argument(
+        "--registry-dir", default=None,
+        help="model registry root for --model-version"
+        " (default $PIO_FS_BASEDIR/registry)",
+    )
+    ev.add_argument(
+        "--snapshot-mode", choices=("off", "use", "refresh"), default=None,
+        help="training-snapshot cache for the replay read (same semantics"
+        " as `pio train --snapshot-mode`)",
+    )
+    ev.add_argument("--snapshot-dir", default=None,
+                    help="snapshot root (default $PIO_FS_BASEDIR/snapshots)")
+    ev.add_argument(
+        "--no-retrieval-guard", action="store_true",
+        help="skip the scan-vs-mips shortlist-recall/identity guard"
+        " (runs by default when the algorithm has a retrieval surface)",
+    )
     ev.set_defaults(func=cmd_eval)
 
     from predictionio_tpu.analysis.engine import add_check_arguments
@@ -467,6 +527,46 @@ def _resolve_dotted(dotted: str, engine_dir: str):
     return obj()
 
 
+def _cmd_replay_eval(args: argparse.Namespace) -> int:
+    import json
+
+    from predictionio_tpu.eval.replay import run_replay_eval
+    from predictionio_tpu.online.registry import RegistryError
+
+    variant = _load_variant(args)
+    # env mirror for ctx-free layers, same as cmd_train
+    if args.snapshot_mode:
+        variant.runtime_conf["pio.snapshot_mode"] = args.snapshot_mode
+        os.environ["PIO_SNAPSHOT_MODE"] = args.snapshot_mode
+    if args.snapshot_dir:
+        variant.runtime_conf["pio.snapshot_dir"] = args.snapshot_dir
+        os.environ["PIO_SNAPSHOT_DIR"] = args.snapshot_dir
+    try:
+        report = run_replay_eval(
+            variant,
+            split_time=args.split_time,
+            split_frac=args.split_frac,
+            k=args.k,
+            metrics=args.metrics,
+            model_version=args.model_version,
+            registry_dir=args.registry_dir,
+            retrieval_guard=not args.no_retrieval_guard,
+        )
+    except (ValueError, NotImplementedError, RegistryError) as exc:
+        # exit-2 contract (mirrors `pio check --rules`): a bad metric name,
+        # malformed boundary, unsupported engine, or GC'd pinned version is
+        # an actionable one-liner, never a traceback
+        print(f"Error: {exc}")
+        return 2
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.output_path:
+        with open(args.output_path, "w") as f:
+            f.write(text + "\n")
+        print(f"Results written to {args.output_path}")
+    return 0
+
+
 def cmd_eval(args: argparse.Namespace) -> int:
     from predictionio_tpu.controller.metrics import (
         EngineParamsGenerator,
@@ -474,6 +574,14 @@ def cmd_eval(args: argparse.Namespace) -> int:
     )
     from predictionio_tpu.workflow.core_workflow import run_evaluation
 
+    if args.replay:
+        return _cmd_replay_eval(args)
+    if not args.evaluation:
+        print(
+            "Error: pio eval needs a dotted Evaluation path, or --replay"
+            " for the offline replay harness"
+        )
+        return 2
     evaluation = _resolve_dotted(args.evaluation, args.engine_dir)
     if not isinstance(evaluation, Evaluation):
         raise SystemExit(

@@ -97,6 +97,30 @@ def _populate(le, events: int, users: int, items: int) -> None:
     )
 
 
+def _timed_events(events: int, users: int, items: int) -> list:
+    """The seeded rating stream with a FIXED time base (13 ms spacing):
+    every index maps to one replayable timestamp, so the quality arm's
+    split boundary is an exact `--split-time`, not a wall-clock race."""
+    import datetime as _dt
+
+    from predictionio_tpu.data import DataMap, Event
+
+    rng = np.random.default_rng(17)
+    base = _dt.datetime(2024, 1, 1, tzinfo=_dt.timezone.utc)
+    return [
+        Event(
+            event="rate",
+            entity_type="user",
+            entity_id=f"u{rng.integers(0, users)}",
+            target_entity_type="item",
+            target_entity_id=f"i{rng.integers(0, items)}",
+            properties=DataMap({"rating": float(rng.integers(1, 6))}),
+            event_time=base + _dt.timedelta(milliseconds=13 * k),
+        )
+        for k in range(events)
+    ]
+
+
 def _ingest_one(wal, le, user: str, item: str) -> float:
     """One durable ingest through the WAL pipeline's exact cycle; returns
     the ack time (the freshness clock's zero)."""
@@ -288,6 +312,116 @@ def run_ab(
     return report
 
 
+def run_quality(
+    events: int = 2_000,
+    users: int = 60,
+    items: int = 30,
+    rank: int = 8,
+    iterations: int = 3,
+    base_frac: float = 0.6,
+    split_frac: float = 0.8,
+    k: int = 10,
+    workdir: str | None = None,
+) -> dict:
+    """The freshness A/B's quality counterpart: does fold-in COST accuracy?
+
+    Leakage-free staging on one seeded, fixed-time-base stream:
+
+    1. the prefix ``[0, base_frac)`` trains the base model (``run_train``);
+    2. the window ``[base_frac, split_frac)`` arrives through the durable
+       ingest cycle (store + WAL), and ONE ``pio retrain`` catch-up cycle
+       folds it in, publishing a registry generation;
+    3. the holdout ``[split_frac, 1)`` lands store-only -- the future
+       neither arm may see at train time;
+    4. ``pio eval --replay`` at the boundary scores the folded generation
+       (``--model-version``) against a forced-full-retrain on the exact
+       same prefix, reporting the NDCG@k the shortcut gave up.
+    """
+    from predictionio_tpu.data.ingest import wal_payload
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.wal import WriteAheadLog
+    from predictionio_tpu.eval.replay import run_replay_eval
+    from predictionio_tpu.online.foldin import StalenessBudget
+    from predictionio_tpu.online.loop import RetrainConfig, RetrainLoop
+    from predictionio_tpu.online.registry import ModelRegistry
+    from predictionio_tpu.workflow.core_workflow import run_train
+    from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="pio_retrain_quality_")
+    i_base = int(events * base_frac)
+    i_split = int(events * split_frac)
+    stream = _timed_events(events, users, items)
+    t_split_iso = stream[i_split].event_time.isoformat()
+    ndcg_key = f"ndcg_at_{k}"
+    report: dict = {
+        "events": events, "users": users, "items": items, "rank": rank,
+        "base_events": i_base, "window_events": i_split - i_base,
+        "holdout_events": events - i_split, "split_time": t_split_iso,
+    }
+    with _Env(workdir):
+        storage_registry.get_meta_data_apps().insert(App(name=APP))
+        le = storage_registry.get_l_events()
+        le.init_channel(APP_ID)
+        le.batch_insert(stream[:i_base], app_id=APP_ID)
+        variant = load_engine_variant(_engine_json(workdir, rank, iterations))
+        run_train(variant)
+
+        wal = WriteAheadLog(os.path.join(workdir, "wal"))
+        try:
+            window = [e.with_id() for e in stream[i_base:i_split]]
+            seqno = 0
+            for event in window:
+                seqno = wal.append(wal_payload(event, APP_ID, None))
+            wal.sync()
+            le.insert_batch([(e, APP_ID, None) for e in window],
+                            on_duplicate="ignore")
+            wal.checkpoint(seqno)
+            loop = RetrainLoop(
+                variant,
+                RetrainConfig(
+                    interval_s=0.1,
+                    budget=StalenessBudget(
+                        max_touched_frac=1.0,
+                        max_item_growth_frac=1.0,
+                        max_user_growth_frac=10.0,
+                    ),
+                    max_cycles=1,
+                ),
+            )
+            report["cycles"] = loop.run_follow()
+            entry = ModelRegistry.for_variant(variant).latest()
+            if entry is None:
+                raise RuntimeError(
+                    "fold-in cycle published no registry generation"
+                )
+            report["folded_version"] = entry.version
+            report["folded_source"] = entry.source
+            # the future: store-only, invisible to both arms' training
+            le.batch_insert(stream[i_split:], app_id=APP_ID)
+            folded = run_replay_eval(
+                variant, split_time=t_split_iso, k=k,
+                model_version=entry.version, retrieval_guard=False,
+            )
+            full = run_replay_eval(
+                variant, split_time=t_split_iso, k=k, retrieval_guard=False,
+            )
+        finally:
+            wal.close()
+    report["folded_metrics"] = folded["metrics"]
+    report["full_retrain_metrics"] = full["metrics"]
+    report["holdout_users"] = folded["split"]["holdout_users"]
+    a, b = folded["metrics"][ndcg_key], full["metrics"][ndcg_key]
+    report["ndcg_delta_full_minus_folded"] = (
+        round(b - a, 6) if a is not None and b is not None else None
+    )
+    if own_tmp:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return report
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--events", type=int, default=2_000)
@@ -298,17 +432,38 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--probes", type=int, default=4)
     parser.add_argument("--load-clients", type=int, default=2)
     parser.add_argument("--no-full-retrain-arm", action="store_true")
-    args = parser.parse_args(argv)
-    report = run_ab(
-        events=args.events,
-        users=args.users,
-        items=args.items,
-        rank=args.rank,
-        iterations=args.iterations,
-        probes=args.probes,
-        load_clients=args.load_clients,
-        full_retrain_arm=not args.no_full_retrain_arm,
+    parser.add_argument(
+        "--quality", action="store_true",
+        help="measure fold-in accuracy instead of freshness: folded model"
+        " vs forced-full-retrain on the same held-out replay split"
+        " (NDCG delta)",
     )
+    parser.add_argument("--split-frac", type=float, default=0.8,
+                        help="--quality replay boundary (default 0.8)")
+    parser.add_argument("--k", type=int, default=10,
+                        help="--quality ranking cutoff (default 10)")
+    args = parser.parse_args(argv)
+    if args.quality:
+        report = run_quality(
+            events=args.events,
+            users=args.users,
+            items=args.items,
+            rank=args.rank,
+            iterations=args.iterations,
+            split_frac=args.split_frac,
+            k=args.k,
+        )
+    else:
+        report = run_ab(
+            events=args.events,
+            users=args.users,
+            items=args.items,
+            rank=args.rank,
+            iterations=args.iterations,
+            probes=args.probes,
+            load_clients=args.load_clients,
+            full_retrain_arm=not args.no_full_retrain_arm,
+        )
     print(json.dumps(report, indent=2))
     return 0
 

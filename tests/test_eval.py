@@ -1,0 +1,572 @@
+"""Offline replay evaluation (`pio eval --replay`): time-travel split
+exactness, vectorized-metric parity with a per-user oracle, the template
+``read_replay`` hooks, the scan-vs-mips retrieval guard, CLI error
+contracts, and the bench quality gate at toy scale."""
+
+import datetime as dt
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.eval.metrics import (
+    METRIC_CATALOG,
+    ranking_metrics,
+    select_metrics,
+)
+from predictionio_tpu.eval.split import (
+    SplitSpec,
+    parse_split_time,
+    resolve_split_seconds,
+    split_interactions,
+)
+
+UTC = dt.timezone.utc
+BASE = dt.datetime(2024, 1, 1, tzinfo=UTC)
+
+
+def ts(seconds: float) -> float:
+    return (BASE + dt.timedelta(seconds=seconds)).timestamp()
+
+
+class TestSplit:
+    def test_boundary_event_lands_in_holdout(self):
+        """``times >= t`` is exact: the event stamped exactly at the
+        boundary (down to the microsecond) is held out, one microsecond
+        earlier trains."""
+        t_iso = (BASE + dt.timedelta(seconds=10)).isoformat()
+        times = np.array([ts(10) - 1e-6, ts(10), ts(10) + 1e-6])
+        users = np.array([0, 1, 2])
+        items = np.array([0, 1, 2])
+        cut = split_interactions(
+            users, items, times, SplitSpec(split_time=t_iso)
+        )
+        assert cut.train_mask.tolist() == [True, False, False]
+        assert sorted(cut.holdout) == [1, 2]
+        assert cut.bounds.train_events == 1
+        assert cut.bounds.holdout_events == 2
+
+    def test_frac_boundary_is_replayable(self):
+        """A --split-frac resolves to a real event timestamp; re-running
+        with that timestamp as --split-time reproduces the exact cut."""
+        rng = np.random.default_rng(5)
+        times = np.array([ts(s) for s in rng.uniform(0, 100, size=50)])
+        users = rng.integers(0, 8, size=50)
+        items = rng.integers(0, 12, size=50)
+        spec = SplitSpec(split_frac=0.7)
+        seconds = resolve_split_seconds(times, spec)
+        assert seconds in times  # an actual event's stamp, not a midpoint
+        cut_frac = split_interactions(users, items, times, spec)
+        cut_time = split_interactions(
+            users, items, times,
+            SplitSpec(split_time=cut_frac.bounds.split_time_iso),
+        )
+        assert (cut_frac.train_mask == cut_time.train_mask).all()
+        assert {
+            u: v.tolist() for u, v in cut_frac.holdout.items()
+        } == {u: v.tolist() for u, v in cut_time.holdout.items()}
+
+    def test_parse_split_time_formats(self):
+        """Z-suffix, explicit offset, and naive-as-UTC all parse to the
+        same instant -- the event-ingestion parse contract."""
+        z = parse_split_time("2024-06-01T12:00:00Z")
+        off = parse_split_time("2024-06-01T12:00:00+00:00")
+        naive = parse_split_time("2024-06-01T12:00:00")
+        micro = parse_split_time("2024-06-01T12:00:00.000001+00:00")
+        assert z == off == naive
+        # one microsecond at epoch scale, within float64 ulp (~2.4e-7 s)
+        assert micro > z
+        assert micro - z == pytest.approx(1e-6, abs=5e-7)
+
+    def test_parse_split_time_malformed(self):
+        with pytest.raises(ValueError, match="ISO-8601"):
+            parse_split_time("last tuesday")
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            SplitSpec().validate()
+        with pytest.raises(ValueError, match="exactly one"):
+            SplitSpec(split_time="2024-01-01", split_frac=0.5).validate()
+        with pytest.raises(ValueError, match="split-frac"):
+            SplitSpec(split_frac=1.5).validate()
+        with pytest.raises(ValueError, match="--k"):
+            SplitSpec(split_frac=0.5, k=0).validate()
+
+    def test_empty_stream_raises(self):
+        with pytest.raises(ValueError, match="no events"):
+            resolve_split_seconds(np.empty(0), SplitSpec(split_frac=0.5))
+
+
+def oracle_metrics(predicted, actual, k):
+    """Plain per-user python scoring loop -- the reference the batched
+    numpy path must match to 1e-9."""
+    hit, ndcg, mrr, recall = [], [], [], []
+    for p, a in zip(predicted, actual):
+        p = list(p)[:k]
+        a = set(a)
+        rel = [i in a for i in p]
+        hit.append(1.0 if any(rel) else 0.0)
+        dcg = sum(r / np.log2(j + 2) for j, r in enumerate(rel))
+        idcg = sum(1 / np.log2(j + 2) for j in range(min(len(a), k)))
+        ndcg.append(dcg / idcg if idcg > 0 else 0.0)
+        mrr.append(
+            1.0 / (rel.index(True) + 1) if any(rel) else 0.0
+        )
+        recall.append(sum(rel) / len(a) if a else 0.0)
+    n = len(predicted)
+    return {
+        "hit_rate": sum(hit) / n, "ndcg": sum(ndcg) / n,
+        "mrr": sum(mrr) / n, "recall": sum(recall) / n,
+    }
+
+
+class TestMetrics:
+    def _random_batch(self, seed, users=40, catalog=60, k=10):
+        rng = np.random.default_rng(seed)
+        ids = [f"item-{i}" for i in range(catalog)]
+        predicted = [
+            list(rng.choice(ids, size=int(rng.integers(0, k + 3)),
+                            replace=False))
+            for _ in range(users)
+        ]
+        actual = [
+            list(rng.choice(ids, size=int(rng.integers(0, 8)),
+                            replace=False))
+            for _ in range(users)
+        ]
+        return predicted, actual
+
+    @pytest.mark.parametrize("seed,k", [(0, 10), (1, 5), (2, 1), (3, 20)])
+    def test_matches_per_user_oracle(self, seed, k):
+        predicted, actual = self._random_batch(seed, k=k)
+        got = ranking_metrics(predicted, actual, k)
+        want = oracle_metrics(predicted, actual, k)
+        for name in METRIC_CATALOG:
+            assert got[name] == pytest.approx(want[name], abs=1e-9), name
+
+    def test_batched_equals_per_user_loop(self):
+        """Scoring the batch at once equals averaging one-user calls --
+        no cross-user coupling in the vectorized path."""
+        predicted, actual = self._random_batch(7)
+        batched = ranking_metrics(predicted, actual, 10)
+        singles = [
+            ranking_metrics([p], [a], 10)
+            for p, a in zip(predicted, actual)
+        ]
+        for name in METRIC_CATALOG:
+            mean = sum(s[name] for s in singles) / len(singles)
+            assert batched[name] == pytest.approx(mean, abs=1e-9), name
+
+    def test_empty_batch_returns_none(self):
+        assert ranking_metrics([], [], 10) == {
+            n: None for n in METRIC_CATALOG
+        }
+
+    def test_empty_actual_user_scores_zero(self):
+        got = ranking_metrics([["a", "b"]], [[]], 5)
+        assert got == {"hit_rate": 0.0, "ndcg": 0.0, "mrr": 0.0,
+                       "recall": 0.0}
+
+    def test_mismatched_lengths(self):
+        with pytest.raises(ValueError, match="counts differ"):
+            ranking_metrics([["a"]], [], 5)
+
+    def test_select_metrics(self):
+        assert select_metrics(None) == tuple(METRIC_CATALOG)
+        assert select_metrics("mrr, ndcg") == ("ndcg", "mrr")  # catalog order
+        with pytest.raises(ValueError) as exc:
+            select_metrics("ndcg,bogus")
+        assert "bogus" in str(exc.value) and "hit_rate" in str(exc.value)
+
+
+def timed_movie_app(storage_env, *, cold_user=False, insert_tail=True):
+    """Two disjoint-taste cliques on a strict timeline: each user rates
+    four of their six liked items in the prefix (1 s apart), then rates a
+    fifth liked item in the tail -- so the held-out item is unseen by the
+    user but well-trained by clique-mates, and a competent model hits it.
+    Returns (app_id, boundary ISO string, tail events). With
+    ``insert_tail=False`` the tail is returned un-inserted so a test can
+    stage it after training a live model on the prefix."""
+    app_id = storage_env.get_meta_data_apps().insert(App(name="EvalApp"))
+    le = storage_env.get_l_events()
+    le.init_channel(app_id)
+    prefix, tail = [], []
+    for g in range(2):
+        liked = [f"g{g}i{i}" for i in range(6)]
+        for u in range(6):
+            user = f"g{g}u{u}"
+            picks = [liked[(u + j) % 6] for j in range(5)]
+            for item in picks[:4]:
+                prefix.append((user, item, 5.0))
+            tail.append((user, picks[4], 5.0))
+            other = f"g{1 - g}i{u % 6}"
+            prefix.append((user, other, 1.0))
+    if cold_user:
+        tail.append(("coldstart", "g0i0", 5.0))
+    boundary = BASE + dt.timedelta(seconds=len(prefix))
+
+    def to_events(rows, offset=0):
+        return [
+            Event(event="rate", entity_type="user", entity_id=u,
+                  target_entity_type="item", target_entity_id=i,
+                  properties=DataMap({"rating": r}),
+                  event_time=BASE + dt.timedelta(seconds=offset + n))
+            for n, (u, i, r) in enumerate(rows)
+        ]
+
+    tail_events = to_events(tail, offset=len(prefix))
+    le.batch_insert(to_events(prefix), app_id=app_id)
+    if insert_tail:
+        le.batch_insert(tail_events, app_id=app_id)
+    return app_id, boundary.isoformat(), tail_events
+
+
+def write_variant(tmp_path, *, app="EvalApp", factory=(
+        "predictionio_tpu.models.recommendation.engine.engine_factory"),
+        algo="als", **params):
+    params.setdefault("rank", 8)
+    params.setdefault("numIterations", 8)
+    params.setdefault("seed", 3)
+    path = tmp_path / "engine.json"
+    path.write_text(json.dumps({
+        "id": "eval-test",
+        "engineFactory": factory,
+        "datasource": {"params": {"appName": app}},
+        "algorithms": [{"name": algo, "params": params}],
+    }))
+    return str(path)
+
+
+def load_variant(path):
+    from predictionio_tpu.workflow.json_extractor import load_engine_variant
+
+    return load_engine_variant(path)
+
+
+class TestReplayRecommendation:
+    def test_deterministic_report_and_retrieval_guard(
+        self, storage_env, tmp_path
+    ):
+        from predictionio_tpu.eval.replay import run_replay_eval
+
+        _, boundary, _ = timed_movie_app(storage_env)
+        variant = load_variant(write_variant(tmp_path))
+        r1 = run_replay_eval(variant, split_time=boundary)
+        r2 = run_replay_eval(variant, split_time=boundary)
+        assert json.dumps(r1, sort_keys=True) == json.dumps(
+            r2, sort_keys=True
+        )
+        # every held-out item is an unseen liked item: the clique model
+        # must recover most of them
+        assert r1["split"]["holdout_users"] == 12
+        assert r1["metrics"]["hit_rate_at_10"] >= 0.75
+        assert r1["metrics"]["ndcg_at_10"] > 0.2
+        assert r1["model"]["source"] == "replay-train"
+        # the acceptance contract: scan and mips agree exactly at the
+        # default shortlist budget
+        guard = r1["retrieval_guard"]
+        assert guard["shortlist_recall_at_10"] == 1.0
+        assert guard["response_identity_rate"] == 1.0
+        assert guard["users_compared"] == 12
+
+    def test_boundary_event_held_out_e2e(self, storage_env, tmp_path):
+        from predictionio_tpu.eval.replay import run_replay_eval
+
+        _, boundary, _ = timed_movie_app(storage_env)
+        variant = load_variant(write_variant(tmp_path))
+        report = run_replay_eval(
+            variant, split_time=boundary, retrieval_guard=False
+        )
+        # the first tail event is stamped exactly at the boundary
+        assert report["split"]["holdout_from_iso"] == boundary
+        assert report["split"]["split_time_iso"] == boundary
+
+    def test_empty_holdout_reports_none(self, storage_env, tmp_path):
+        from predictionio_tpu.eval.replay import run_replay_eval
+
+        timed_movie_app(storage_env)
+        variant = load_variant(write_variant(tmp_path))
+        late = (BASE + dt.timedelta(days=30)).isoformat()
+        report = run_replay_eval(
+            variant, split_time=late, retrieval_guard=False
+        )
+        assert report["split"]["holdout_users"] == 0
+        assert all(v is None for v in report["metrics"].values())
+
+    def test_cold_user_counts_as_honest_miss(self, storage_env, tmp_path):
+        """A user who only ever appears after the boundary stays in the
+        fold and drags the metrics down instead of being dropped."""
+        from predictionio_tpu.eval.replay import run_replay_eval
+
+        _, boundary, _ = timed_movie_app(storage_env, cold_user=True)
+        variant = load_variant(write_variant(tmp_path))
+        report = run_replay_eval(
+            variant, split_time=boundary, retrieval_guard=False,
+            include_responses=True,
+        )
+        assert report["split"]["holdout_users"] == 13
+        by_user = dict(zip(
+            [q["user"] for q in report["queries"]], report["responses"]
+        ))
+        assert "coldstart" in by_user
+
+    def test_responses_match_live_query_server(self, storage_env, tmp_path):
+        """Seen-filter parity: the replay responses byte-match a live
+        /queries.json server deployed from a model trained on the same
+        prefix -- replay is the serving path, not a reimplementation."""
+        import requests
+
+        from predictionio_tpu.eval.replay import run_replay_eval
+        from predictionio_tpu.workflow.core_workflow import run_train
+        from predictionio_tpu.workflow.create_server import (
+            create_query_server,
+        )
+
+        _, boundary, _ = timed_movie_app(storage_env)
+        variant = load_variant(write_variant(tmp_path))
+        report = run_replay_eval(
+            variant, split_time=boundary, retrieval_guard=False,
+            include_responses=True,
+        )
+        # deploy a server trained on the SAME prefix: replay holds out
+        # the tail, so delete it from the store before training live
+        le = storage_env.get_l_events()
+        cutoff = parse_split_time(boundary)
+        for ev in list(le.find(app_id=1)):
+            if ev.event_time.timestamp() >= cutoff:
+                le.delete(ev.event_id, app_id=1)
+        run_train(variant)
+        thread, _service = create_query_server(
+            variant, host="127.0.0.1", port=0
+        )
+        thread.start()
+        try:
+            for query, replay_response in zip(
+                report["queries"], report["responses"]
+            ):
+                r = requests.post(
+                    f"http://127.0.0.1:{thread.port}/queries.json",
+                    json=query, timeout=30,
+                )
+                assert r.status_code == 200
+                assert r.json() == replay_response, query
+        finally:
+            thread.stop()
+
+
+class TestReplayOtherTemplates:
+    def _timed_shop(self, storage_env):
+        """Two buy-cliques on a timeline; each user's last buy is an
+        unseen in-clique item."""
+        app_id = storage_env.get_meta_data_apps().insert(App(name="EvalShop"))
+        le = storage_env.get_l_events()
+        le.init_channel(app_id)
+        prefix, tail = [], []
+        for g in range(2):
+            liked = [f"g{g}i{i}" for i in range(6)]
+            for u in range(6):
+                user = f"g{g}u{u}"
+                picks = [liked[(u + j) % 6] for j in range(5)]
+                prefix += [(user, i) for i in picks[:4]]
+                tail.append((user, picks[4]))
+        events = [
+            Event(event="buy", entity_type="user", entity_id=u,
+                  target_entity_type="item", target_entity_id=i,
+                  event_time=BASE + dt.timedelta(seconds=n))
+            for n, (u, i) in enumerate(prefix + tail)
+        ]
+        le.batch_insert(events, app_id=app_id)
+        return (BASE + dt.timedelta(seconds=len(prefix))).isoformat()
+
+    def test_ecommerce_replay_deterministic(self, storage_env, tmp_path):
+        from predictionio_tpu.eval.replay import run_replay_eval
+
+        boundary = self._timed_shop(storage_env)
+        variant = load_variant(write_variant(
+            tmp_path, app="EvalShop", algo="ecomm",
+            factory="predictionio_tpu.models.ecommerce.engine.engine_factory",
+        ))
+        r1 = run_replay_eval(variant, split_time=boundary)
+        r2 = run_replay_eval(variant, split_time=boundary)
+        assert json.dumps(r1, sort_keys=True) == json.dumps(
+            r2, sort_keys=True
+        )
+        assert r1["split"]["holdout_users"] == 12
+        assert r1["metrics"]["hit_rate_at_10"] >= 0.75
+        assert r1["retrieval_guard"]["shortlist_recall_at_10"] == 1.0
+        assert r1["retrieval_guard"]["response_identity_rate"] == 1.0
+
+    def test_similarproduct_anchors_on_train_prefix_only(self, storage_env):
+        """read_replay must anchor each held-out user's query on their
+        TRAINING items only -- anchoring on held-out events would leak
+        the future and self-exclude the actuals."""
+        from predictionio_tpu.models.similarproduct.engine import (
+            SimilarProductDataSource,
+        )
+        from predictionio_tpu.workflow.context import RuntimeContext
+
+        boundary = self._timed_shop(storage_env)
+        ds = SimilarProductDataSource(
+            {"appName": "EvalShop", "eventNames": ["buy"]}
+        )
+        fold = ds.read_replay(
+            RuntimeContext(), SplitSpec(split_time=boundary, k=5)
+        )
+        assert len(fold.pairs) == 12
+        train_items = set()
+        data = ds._read()
+        for u, i, keep in zip(
+            data.users, data.items,
+            data.times < parse_split_time(boundary),
+        ):
+            if keep:
+                train_items.add((int(u), data.item_ids[int(i)]))
+        train_by_user = {}
+        for u, item in train_items:
+            train_by_user.setdefault(data.user_ids[u], set()).add(item)
+        for query, actual in fold.pairs:
+            assert query["num"] == 5
+            anchors = set(query["items"])
+            # every anchor comes from some user's train prefix, and no
+            # anchor is one of that pair's held-out actuals
+            assert anchors and not anchors & set(actual)
+            assert any(
+                anchors <= seen for seen in train_by_user.values()
+            )
+
+
+class TestReplayCLI:
+    def _seed(self, storage_env, tmp_path):
+        _, boundary, _ = timed_movie_app(storage_env)
+        return write_variant(tmp_path, numIterations=4), boundary
+
+    def test_cli_round_trip(self, storage_env, tmp_path, capsys):
+        from tests.test_cli import run
+
+        variant_path, boundary = self._seed(storage_env, tmp_path)
+        out_path = tmp_path / "report.json"
+        code, out = run(
+            capsys, "eval", "--replay", "--variant", variant_path,
+            "--split-time", boundary, "--k", "5",
+            "--metrics", "ndcg,hit_rate", "--no-retrieval-guard",
+            "--output-path", str(out_path),
+        )
+        assert code == 0
+        report = json.loads(out_path.read_text())
+        assert set(report["metrics"]) == {"ndcg_at_5", "hit_rate_at_5"}
+        assert report["split"]["split_time_iso"] == boundary
+        assert f"Results written to {out_path}" in out
+
+    def test_cli_split_frac_round_trip(self, storage_env, tmp_path, capsys):
+        from tests.test_cli import run
+
+        variant_path, _ = self._seed(storage_env, tmp_path)
+        out_path = tmp_path / "report.json"
+        code, _ = run(
+            capsys, "eval", "--replay", "--variant", variant_path,
+            "--split-frac", "0.8", "--no-retrieval-guard",
+            "--output-path", str(out_path),
+        )
+        assert code == 0
+        report = json.loads(out_path.read_text())
+        assert report["split"]["split_frac"] == 0.8
+        assert report["split"]["holdout_events"] > 0
+
+    def test_cli_unknown_metric_exit2_with_catalog(
+        self, storage_env, tmp_path, capsys
+    ):
+        from tests.test_cli import run
+
+        variant_path, boundary = self._seed(storage_env, tmp_path)
+        code, out = run(
+            capsys, "eval", "--replay", "--variant", variant_path,
+            "--split-time", boundary, "--metrics", "precision",
+        )
+        assert code == 2
+        assert "unknown metric" in out and "hit_rate" in out
+
+    def test_cli_malformed_split_time_exit2(
+        self, storage_env, tmp_path, capsys
+    ):
+        from tests.test_cli import run
+
+        variant_path, _ = self._seed(storage_env, tmp_path)
+        code, out = run(
+            capsys, "eval", "--replay", "--variant", variant_path,
+            "--split-time", "jan 5th",
+        )
+        assert code == 2
+        assert "malformed --split-time" in out and "ISO-8601" in out
+
+    def test_cli_both_split_args_exit2(self, storage_env, tmp_path, capsys):
+        from tests.test_cli import run
+
+        variant_path, boundary = self._seed(storage_env, tmp_path)
+        code, out = run(
+            capsys, "eval", "--replay", "--variant", variant_path,
+            "--split-time", boundary, "--split-frac", "0.5",
+        )
+        assert code == 2
+        assert "exactly one" in out
+
+    def test_cli_missing_model_version_exit2(
+        self, storage_env, tmp_path, capsys
+    ):
+        from tests.test_cli import run
+
+        variant_path, boundary = self._seed(storage_env, tmp_path)
+        code, out = run(
+            capsys, "eval", "--replay", "--variant", variant_path,
+            "--split-time", boundary, "--model-version", "7",
+            "--registry-dir", str(tmp_path / "registry"),
+        )
+        assert code == 2
+        assert "model version 7" in out and "retained" in out
+
+    def test_cli_eval_without_replay_needs_evaluation(
+        self, storage_env, capsys
+    ):
+        from tests.test_cli import run
+
+        code, out = run(capsys, "eval")
+        assert code == 2
+        assert "--replay" in out
+
+
+class TestQualityBench:
+    def test_eval_bench_toy(self, tmp_path):
+        """The bench.py quality-gate metric at toy scale: the guard must
+        report exact scan/mips agreement on the default config."""
+        from predictionio_tpu.tools.eval_bench import run_eval_quality
+
+        rep = run_eval_quality(
+            events=400, users=16, items=48, rank=4, iterations=2,
+            workdir=str(tmp_path),
+        )
+        assert rep["holdout_users"] > 0
+        assert rep["mips_recall_at_10"] == 1.0
+        assert rep["response_identity_rate"] == 1.0
+        assert 0.0 <= rep["eval_ndcg_at_10"] <= 1.0
+
+    @pytest.mark.slow
+    def test_retrain_quality_ab(self, tmp_path):
+        """Folded vs forced-full-retrain on the same held-out split: the
+        A/B harness stages prefix -> WAL window -> fold-in cycle, and
+        both arms score the same holdout."""
+        from predictionio_tpu.tools.retrain_bench import run_quality
+
+        rep = run_quality(
+            events=900, users=30, items=20, rank=8, iterations=3,
+            workdir=str(tmp_path),
+        )
+        assert rep["cycles"]["foldin"] == 1
+        assert rep["folded_source"] == "foldin"
+        assert rep["folded_metrics"]["ndcg_at_10"] is not None
+        assert rep["full_retrain_metrics"]["ndcg_at_10"] is not None
+        assert rep["ndcg_delta_full_minus_folded"] == pytest.approx(
+            rep["full_retrain_metrics"]["ndcg_at_10"]
+            - rep["folded_metrics"]["ndcg_at_10"],
+            abs=1e-6,
+        )
