@@ -758,6 +758,54 @@ def secondary_main(result_path: str) -> None:
                 out[key] = rep[key]
         return out
 
+    def serving_sharded_qps():
+        """#18: aggregate query-server QPS, single-process baseline vs
+        the hash-partitioned shard fabric at 2 and 4 scorer shards (each
+        shard a separate process holding one partition of the user
+        factor table, item side replicated, one SO_REUSEPORT frontend
+        routing hash(user) % N). Batch-size-1 probe bodies must be
+        byte-identical across every arm -- partitioning selects rows,
+        it never changes arithmetic -- so this phase is ALSO a standing
+        routing/scatter correctness gate. On the 2-core box the sweep
+        measures process overhead, not scaling; the sweep exists as the
+        trend line for real multi-core hardware (see
+        `serving_bench --scorer-shards 1,2,4,8`). CPU-only like
+        serving_qps."""
+        if tpu:
+            return {
+                "skipped": "CPU-only phase (TPU child shares an already-"
+                "initialized backend)"
+            }
+        from predictionio_tpu.tools.serving_bench import run_sharded_ab
+
+        rep = run_sharded_ab(
+            "recommendation",
+            concurrency=32,
+            requests=1200,
+            shards=(1, 2, 4),
+            users=300,
+            items=30_000,
+            events=60_000,
+        )
+        out = {
+            "qps_shards_1": rep["shards_1"]["qps"],
+            "responses_identical": rep["responses_identical"],
+            "responses_equivalent": rep["responses_equivalent"],
+            "qps_speedup": rep["qps_speedup"],
+            "config": "#18 serving_sharded_qps (32 raw clients, 30k"
+            " items, rank 64, shards 1/2/4)",
+        }
+        for label, arm in rep.items():
+            if not (label.startswith("shards_") and isinstance(arm, dict)):
+                continue
+            out[f"qps_{label}"] = arm["qps"]
+            out[f"p50_ms_{label}"] = arm["p50_ms"]
+            out[f"failures_{label}"] = arm["failures"]
+        for key in rep:
+            if key.startswith("qps_speedup_shards_"):
+                out[key] = rep[key]
+        return out
+
     def analysis_findings():
         """#10: the `pio check` static-analysis gate as a zero-cost
         regression metric. `analysis_findings_total` (unsuppressed) must
@@ -927,6 +975,7 @@ def secondary_main(result_path: str) -> None:
     phase("mips_topk", mips_topk)
     phase("trace_overhead_pct", trace_overhead_pct)
     phase("serving_qps_multiproc", serving_qps_multiproc)
+    phase("serving_sharded_qps", serving_sharded_qps)
     phase("als_stream", als_stream)
     phase("analysis_findings", analysis_findings)
     phase("online_freshness_seconds", online_freshness)

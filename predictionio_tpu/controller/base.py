@@ -164,6 +164,19 @@ class Algorithm(Component, Generic[PD, M, Q, P]):
         build serving caches (device-resident tables, compiled programs) so
         the first query doesn't pay for them. Must be safe to skip."""
 
+    def shard_model(self, model: M, shard: int, num_shards: int) -> M:
+        """Restrict ``model`` to the user partition ``serving.shardmap.
+        shard_of(user, num_shards) == shard`` owns, returning a NEW model
+        (the swap protocol needs immutability). Item-side and other
+        replicated state must stay intact: every shard answers userless /
+        item-only queries identically, and a query routed to the owning
+        shard must be answered byte-for-byte as the unsharded model would.
+
+        Default: return the model unchanged (full replication) -- correct
+        for any algorithm, it just forgoes the memory win.
+        """
+        return model
+
     # -- query/result wire serde (CustomQuerySerializer parity role) --------
     def query_from_json(self, obj: Any) -> Q:
         """Deserialize a /queries.json body. Default: pass the dict through."""

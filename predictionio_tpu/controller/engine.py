@@ -177,10 +177,15 @@ class Engine:
         engine_params: EngineParams,
         instance_id: str,
         model_blob: bytes | None,
+        shard: int | None = None,
+        num_shards: int = 1,
     ) -> list[Any]:
         """Rehydrate per-algorithm models for serving (reference semantics:
         PersistentModelLoader -> load; pickled blob -> deserialize;
-        persist_model=False -> retrain now)."""
+        persist_model=False -> retrain now). With ``shard``/``num_shards``
+        set, the rehydrated models are partitioned through
+        :meth:`shard_models` BEFORE warm-up, so serving caches are built
+        against the shard's slice, never the full table."""
         algorithms = self._algorithms(engine_params)
         entries = pickle.loads(model_blob) if model_blob else [("retrain", None)] * len(
             algorithms
@@ -208,6 +213,8 @@ class Engine:
                 models.append(retrained[i])
             else:  # pragma: no cover - corrupted blob
                 raise ValueError(f"unknown model persistence kind {kind!r}")
+        if shard is not None and num_shards > 1:
+            models = self.shard_models(engine_params, models, shard, num_shards)
         for algorithm, model in zip(algorithms, models):
             # serving caches (device-resident scorers, compiled programs)
             # build at deploy time, not on the unlucky first query. STRICTLY
@@ -225,6 +232,28 @@ class Engine:
                     exc_info=True,
                 )
         return models
+
+    def shard_models(
+        self,
+        engine_params: EngineParams,
+        models: Sequence[Any],
+        shard: int,
+        num_shards: int,
+    ) -> list[Any]:
+        """Per-algorithm :meth:`Algorithm.shard_model` over rehydrated
+        models -- the deploy-side fallback when a registry generation has
+        no per-shard blobs, and the publish-side partition step when the
+        continuous-learning loop writes them."""
+        if num_shards <= 1:
+            return list(models)
+        if not (0 <= shard < num_shards):
+            raise ValueError(
+                f"shard {shard} out of range for num_shards={num_shards}"
+            )
+        return [
+            algorithm.shard_model(model, shard, num_shards)
+            for algorithm, model in zip(self._algorithms(engine_params), models)
+        ]
 
     # -- eval ---------------------------------------------------------------
     def eval(

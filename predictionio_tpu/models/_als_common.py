@@ -15,7 +15,13 @@ import logging
 
 import numpy as np
 
-from predictionio_tpu.parallel.als import ALSConfig, ALSModel, als_fit, build_als_data
+from predictionio_tpu.parallel.als import (
+    ALSConfig,
+    ALSModel,
+    als_fit,
+    als_fit_streamed,
+    build_als_data,
+)
 
 logger = logging.getLogger("pio.als")
 
@@ -530,12 +536,20 @@ def fit_with_checkpoint(
             )
 
     from predictionio_tpu.obs.trace import global_tracer
+    from predictionio_tpu.parallel.stream import StreamedALSData
 
+    # alsFeed "streamed": the preparator handed a disk block store, not
+    # resident edge arrays -- train through ALX device-resident epochs.
+    # Same checkpoints, same callback contract, bit-identical factors at
+    # equal shapes (als_fit_streamed's own invariant).
+    fit = (
+        als_fit_streamed if isinstance(als_data, StreamedALSData) else als_fit
+    )
     try:
         with global_tracer().span(
             "als.fit", attrs={"name": name, "iterations": config.iterations}
         ):
-            model = als_fit(
+            model = fit(
                 als_data,
                 config,
                 mesh,

@@ -261,6 +261,40 @@ def _snapshot_for_handle(handle: StreamingHandle, runtime_conf):
         return None
 
 
+def snapshot_ratings_arrays(handle: StreamingHandle, runtime_conf=None):
+    """Materialized COO arrays replayed from the handle's ready snapshot
+    generation, or None when snapshots are off/unavailable.
+
+    Returns ``(users, items, ratings, times, user_ids, item_ids)`` --
+    the exact shape a datasource's materialized ``_read`` produces, but
+    served from the PR-3 memmap columns: a replay evaluation under
+    ``--snapshot-mode use`` trains its prefix with zero SQL scans, and a
+    second run replays the same pinned generation bit-for-bit.
+    """
+    import numpy as np
+
+    snap = _snapshot_for_handle(handle, runtime_conf)
+    if snap is None:
+        return None
+    from predictionio_tpu.parallel.reader import snapshot_coo_chunks
+
+    source, users_enc, items_enc = snapshot_coo_chunks(
+        snap, chunk_rows=handle.chunk_rows
+    )
+    chunks = list(source())
+    if chunks:
+        users = np.concatenate([c[0] for c in chunks])
+        items = np.concatenate([c[1] for c in chunks])
+        ratings = np.concatenate([c[2] for c in chunks])
+        times = np.concatenate([c[3] for c in chunks])
+    else:
+        users = np.empty(0, np.int64)
+        items = np.empty(0, np.int64)
+        ratings = np.empty(0, np.float32)
+        times = np.empty(0, np.float64)
+    return users, items, ratings, times, list(users_enc.ids), list(items_enc.ids)
+
+
 def streaming_coo_source(
     handle: StreamingHandle,
     runtime_conf=None,
@@ -323,6 +357,24 @@ def streaming_multi_event_sources(handle: StreamingHandle, runtime_conf=None):
     return sources, users_enc, items_enc, False
 
 
+def resolve_als_feed(preparator_params, runtime_conf=None) -> str:
+    """The ALS feed mode: ``pio train --als-feed`` (runtime conf
+    ``pio.als_feed``) overrides the engine's ``alsFeed`` preparator param;
+    default ``resident`` (device-resident edge arrays, the pre-PR-10
+    path). ``streamed`` packs a disk block store and trains through
+    ALX device-resident epochs (``als_fit_streamed``)."""
+    conf = runtime_conf or {}
+    feed = (
+        conf.get("pio.als_feed")
+        or preparator_params.get_or("alsFeed", "resident")
+    )
+    if feed not in ("resident", "streamed"):
+        raise ValueError(
+            f"alsFeed must be 'resident' or 'streamed', got {feed!r}"
+        )
+    return feed
+
+
 def build_streaming_als(handle: StreamingHandle, preparator_params, mesh,
                         event_values: dict[str, float] | None = None,
                         runtime_conf=None):
@@ -332,6 +384,15 @@ def build_streaming_als(handle: StreamingHandle, preparator_params, mesh,
     assembles its own template-specific data carrier around the
     vocabularies. ``runtime_conf`` (the RuntimeContext's) carries the
     ``pio.snapshot_mode``/``pio.snapshot_dir`` opt-in.
+
+    With ``alsFeed: streamed`` (or ``pio train --als-feed streamed``) and
+    a ready snapshot, ``als_data`` comes back as a ``parallel.stream.
+    StreamedALSData`` block store packed straight from the snapshot's
+    memmap columns (``reader.snapshot_streamed_als_data``) --
+    ``fit_with_checkpoint`` dispatches it to ALX device-resident
+    streamed epochs. Without a snapshot the streamed feed degrades to
+    the resident pack with a warning: feed choice tunes memory, it must
+    never fail a train.
     """
     from predictionio_tpu.parallel.als import ALSConfig
     from predictionio_tpu.parallel.reader import build_als_data_sharded
@@ -340,6 +401,22 @@ def build_streaming_als(handle: StreamingHandle, preparator_params, mesh,
         max_len=preparator_params.get_or("maxEventsPerUser", None),
         buckets=preparator_params.get_or("buckets", 1),
     )
+    if resolve_als_feed(preparator_params, runtime_conf) == "streamed":
+        from predictionio_tpu.parallel.reader import snapshot_streamed_als_data
+
+        _agree_until_time(handle)
+        snap = _snapshot_for_handle(handle, runtime_conf)
+        if snap is not None:
+            return snapshot_streamed_als_data(
+                snap, config, mesh=mesh,
+                model_shards=mesh.shape.get("model", 1) if mesh is not None else 1,
+                chunk_rows=handle.chunk_rows,
+                event_values=event_values,
+            )
+        logger.warning(
+            "alsFeed 'streamed' needs a training snapshot (--snapshot-mode"
+            " use|refresh); falling back to the resident feed"
+        )
     source, users_enc, items_enc = streaming_coo_source(
         handle, runtime_conf=runtime_conf, event_values=event_values
     )

@@ -170,16 +170,53 @@ class RecommendationDataSource(DataSource):
             out.append((train, EvalInfo(fold=f), pairs))
         return out
 
+    def _read_replay_source(self, ctx) -> RatingsData:
+        """``_read()``, served from a pinned snapshot generation's memmap
+        columns when ``--snapshot-mode`` enables it: the whole replay eval
+        (prefix training included) then does zero SQL scans, and reruns
+        against the same generation replay identical bytes. Snapshot
+        misses degrade to the direct store read, never fail the eval."""
+        from predictionio_tpu.data.snapshot import snapshot_settings
+        from predictionio_tpu.models._streaming import snapshot_ratings_arrays
+
+        runtime_conf = getattr(ctx, "runtime_conf", None) or {}
+        mode, _root = snapshot_settings(runtime_conf)
+        if mode != "off":
+            handle = build_streaming_handle(
+                self.params, ["rate", "buy"],
+                empty_message="no rating events found -- check appName and "
+                "eventNames",
+            )
+            arrays = snapshot_ratings_arrays(handle, runtime_conf)
+            if arrays is not None:
+                users, items, ratings, times, user_ids, item_ids = arrays
+                return RatingsData(
+                    users=users, items=items, ratings=ratings, times=times,
+                    user_ids=user_ids, item_ids=item_ids,
+                    app_name=self.params.appName,
+                    event_names=list(
+                        self.params.get_or("eventNames", ["rate", "buy"])
+                    ),
+                    channel_name=self.params.get_or("channelName", None),
+                )
+            logger.warning(
+                "replay snapshot unavailable; falling back to the direct"
+                " store scan"
+            )
+        return self._read()
+
     def read_replay(self, ctx, spec):
         """Time-travel replay fold (``pio eval --replay``): train on
         ratings strictly before the boundary, ask for each held-out
         user's top-``spec.k`` (cold holdout users -- no training events
         -- stay in the fold and score as misses). The fold carries
         ``eval_fold=True`` so a ``seenFilter: "live"`` variant downgrades
-        to the trained-in map, exactly like the k-fold path."""
+        to the trained-in map, exactly like the k-fold path. With
+        ``--snapshot-mode use`` the prefix replays a pinned snapshot
+        generation's memmaps instead of the SQL store (PR 17's gap)."""
         from predictionio_tpu.eval.split import ReplayFold, split_interactions
 
-        data = self._read()
+        data = self._read_replay_source(ctx)
         cut = split_interactions(data.users, data.items, data.times, spec)
         train = RatingsData(
             users=data.users[cut.train_mask],
@@ -397,6 +434,60 @@ class ALSAlgorithm(TPUAlgorithm):
         retrieval_index(model.als, self._retrieval, kind="cosine")
 
     supports_fold_in = True
+
+    def shard_model(
+        self, model: RecommendationModel, shard: int, num_shards: int
+    ) -> RecommendationModel:
+        """Keep only the user rows ``shardmap.shard_of`` assigns to
+        ``shard``; item factors, item vocab, and the norm caches'
+        inputs are replicated untouched.
+
+        Row scoring is per-row (einsum over one user's factor vector), so
+        compacting the user table cannot change a kept user's scores by a
+        bit. Users filtered OUT of this shard simply miss ``user_index``
+        -- the cold-user path -- which is correct because the frontend
+        routes their queries to the owning shard; a userless or
+        misrouted query sees only replicated state and answers exactly
+        as every sibling would.
+        """
+        if num_shards <= 1:
+            return model
+        from predictionio_tpu.serving.shardmap import shard_of
+
+        # original row order preserved: renumbering must be a pure
+        # compaction, never a reorder
+        by_row = sorted(model.user_index.items(), key=lambda kv: kv[1])
+        kept = [
+            (uid, row) for uid, row in by_row
+            if shard_of(uid, num_shards) == shard
+        ]
+        rank = model.als.user_factors.shape[1] if model.als.user_factors.ndim == 2 else 0
+        if kept:
+            rows = np.asarray([row for _, row in kept], dtype=np.int64)
+            user_factors = np.ascontiguousarray(model.als.user_factors[rows])
+        else:
+            user_factors = np.empty(
+                (0, rank), dtype=model.als.user_factors.dtype
+            )
+        seen = {
+            new_row: model.seen[old_row]
+            for new_row, (_, old_row) in enumerate(kept)
+            if old_row in model.seen
+        }
+        return RecommendationModel(
+            als=ALSModel(
+                user_factors=user_factors,
+                item_factors=model.als.item_factors,
+            ),
+            user_index={uid: new for new, (uid, _) in enumerate(kept)},
+            item_ids=model.item_ids,
+            item_index=model.item_index,
+            seen=seen,
+            seen_mode=getattr(model, "seen_mode", "model"),
+            app_name=getattr(model, "app_name", ""),
+            event_names=getattr(model, "event_names", None),
+            channel_name=getattr(model, "channel_name", None),
+        )
 
     def fold_in(self, model: RecommendationModel, delta) -> RecommendationModel | None:
         """Continuous-learning hook (``pio retrain --follow``): re-solve

@@ -172,6 +172,13 @@ def compute_stats(prev: dict, cur: dict) -> dict:
             max(v - pfw_req.get(k, 0.0), 0.0) for k, v in fw_req.items()
         )
         stats["frontend_qps"] = round(d_fw / dt, 1)
+    shards = cm.get("pio_scorer_shard_count")
+    if shards:
+        # the sharded serving fabric: scorer shard count in the SHARD
+        # column. pio_model_version carries a shard label there, so the
+        # MODEL column below (max across series) briefly leads by one
+        # version mid-swap -- exactly the fabric's allowed skew window
+        stats["scorer_shards"] = int(max(shards.values()))
     # continuous-learning gauges (pio retrain --follow): which model
     # version is live, how long ago it swapped in, and how many seconds of
     # ingested events are not yet reflected in it
@@ -208,8 +215,8 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
         time.strftime("pio top — %H:%M:%S", time.localtime()),
         "",
         f"{'SERVICE':<32}{'QPS':>8}{'P50MS':>9}{'P99MS':>9}"
-        f"{'ERR%':>7}{'QUEUE':>7}{'BATCH':>7}{'WKR':>5}{'WAKE':>6}"
-        f"{'MODEL':>7}{'SWAP':>8}{'LAG':>7}",
+        f"{'ERR%':>7}{'QUEUE':>7}{'BATCH':>7}{'WKR':>5}{'SHARD':>6}"
+        f"{'WAKE':>6}{'MODEL':>7}{'SWAP':>8}{'LAG':>7}",
     ]
     for s in stats_list:
         if s.get("error"):
@@ -224,6 +231,7 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
             f"{_fmt(s.get('ingest_queue_depth')):>7}"
             f"{_fmt(s.get('batch_occupancy')):>7}"
             f"{_fmt(s.get('frontend_workers')):>5}"
+            f"{_fmt(s.get('scorer_shards')):>6}"
             f"{_fmt(s.get('wakeups_per_request')):>6}"
             f"{_fmt(s.get('model_version')):>7}"
             f"{_fmt(s.get('swap_age_s'), 's'):>8}"

@@ -66,6 +66,11 @@ class RetrainConfig:
     #: escalation switch: False turns StalenessExceeded into a logged skip
     #: (for operators who schedule full retrains out of band)
     allow_full_retrain: bool = True
+    #: publish per-shard model blobs (the `pio deploy --scorer-shards N`
+    #: fabric's swap path) alongside the full blob; fold-in recomputes
+    #: only the shards whose users were touched and carries the rest of
+    #: the bytes forward verbatim. 0 = full blob only.
+    scorer_shards: int = 0
 
 
 class RetrainLoop:
@@ -269,9 +274,13 @@ class RetrainLoop:
         blob = self.engine.serialize_models(
             self.ctx, self.engine_params, self.instance.id, new_models
         )
+        # shard_blobs must be derived BEFORE publish: untouched shards
+        # reuse the still-latest version's bytes verbatim
+        shard_blobs = self._shard_blobs(new_models, batch.touched_users)
         version = self.registry.publish(
             blob,
-            meta=self._meta("foldin", batch, snap),
+            meta=self._meta("foldin", batch, snap, models=new_models),
+            shard_blobs=shard_blobs,
         )
         if not self._notify_swap(version.version):
             self._count("swap_failed")
@@ -327,6 +336,7 @@ class RetrainLoop:
         version = self.registry.publish(
             record.models,
             meta=self._meta("train", batch, snap, instance_id=instance.id),
+            shard_blobs=self._shard_blobs(self.models, None),
         )
         if not self._notify_swap(version.version):
             self._count("swap_failed")
@@ -337,8 +347,11 @@ class RetrainLoop:
         return "full_retrain"
 
     # -- plumbing ------------------------------------------------------------
-    def _meta(self, source: str, batch, snap, instance_id: str | None = None) -> dict:
-        return {
+    def _meta(
+        self, source: str, batch, snap,
+        instance_id: str | None = None, models=None,
+    ) -> dict:
+        meta = {
             "source": source,
             "instance_id": instance_id or self.instance.id,
             "engine_params": self.engine_params.to_json_obj(),
@@ -347,6 +360,77 @@ class RetrainLoop:
             "records": batch.records,
             "touched_users": len(batch.touched_users),
         }
+        if self.config.scorer_shards > 1:
+            meta["shard_item_count"] = self._item_count(
+                self.models if models is None else models
+            )
+        return meta
+
+    @staticmethod
+    def _item_count(models) -> int | None:
+        """Item-vocabulary size across the models, or None when any model
+        does not expose one. This is the reuse guard for untouched-shard
+        bytes: fold-in freezes item factors, but it may APPEND zero rows
+        for new items (within the growth budget), and that changes every
+        shard's replicated item side."""
+        counts = []
+        for model in models:
+            factors = getattr(model, "item_factors", None)
+            if factors is None:
+                factors = getattr(
+                    getattr(model, "als", None), "item_factors", None
+                )
+            if factors is not None and hasattr(factors, "shape"):
+                counts.append(int(factors.shape[0]))
+                continue
+            items = getattr(model, "item_ids", None)
+            if items is not None:
+                counts.append(len(items))
+                continue
+            return None
+        return sum(counts) if counts else None
+
+    def _shard_blobs(self, models, touched_users) -> list[bytes] | None:
+        """Per-shard serialized blobs for ``registry.publish``. A fold-in
+        recomputes ONLY the shards owning touched users; every other
+        shard's bytes are carried forward verbatim from the still-latest
+        version (same shard count, same item vocabulary) -- the publish
+        cost of a small delta stays proportional to the delta.
+        ``touched_users=None`` recomputes everything (full retrain)."""
+        n = self.config.scorer_shards
+        if n <= 1:
+            return None
+        from predictionio_tpu.serving.shardmap import shard_of
+
+        touched: set[int] | None = None
+        prev = None
+        if touched_users is not None:
+            touched = {shard_of(u, n) for u in touched_users}
+            prev = self.registry.latest()
+            if prev is not None and (
+                prev.shard_count != n
+                or prev.manifest.get("shard_item_count")
+                != self._item_count(models)
+            ):
+                prev = None
+        blobs: list[bytes] = []
+        for k in range(n):
+            if prev is not None and touched is not None and k not in touched:
+                try:
+                    blobs.append(prev.load_blob(shard=k))
+                    continue
+                except Exception:
+                    logger.warning(
+                        "could not reuse shard %d bytes from version %d;"
+                        " recomputing", k, prev.version, exc_info=True,
+                    )
+            sharded = self.engine.shard_models(self.engine_params, models, k, n)
+            blobs.append(
+                self.engine.serialize_models(
+                    self.ctx, self.engine_params, self.instance.id, sharded
+                )
+            )
+        return blobs
 
     def _advance(self, batch, snap) -> None:
         self.cursor.advance(

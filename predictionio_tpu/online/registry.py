@@ -96,17 +96,41 @@ class RegistryVersion:
     def engine_params_obj(self) -> dict | None:
         return self.manifest.get("engine_params")
 
-    def load_blob(self) -> bytes:
+    @property
+    def shard_count(self) -> int:
+        """Number of per-shard blobs this generation carries (0 = the
+        pre-shard layout: only the full ``model.bin``)."""
+        shards = self.manifest.get("shards")
+        return int(shards["count"]) if shards else 0
+
+    def load_blob(self, shard: int | None = None) -> bytes:
         """The model blob, CRC-verified on every read (a bit-rotted model
-        must never silently deploy)."""
+        must never silently deploy). ``shard`` selects one per-shard blob
+        (``shard-K/model.bin``) from a generation published with a shard
+        axis; the full blob stays at ``model.bin`` for single-process
+        deploys and byte-identity A/Bs."""
+        if shard is None:
+            blob_path = os.path.join(self.path, _BLOB_NAME)
+            want_crc = self.manifest.get("crc")
+        else:
+            shards = self.manifest.get("shards")
+            if not shards or not (0 <= int(shard) < int(shards["count"])):
+                raise RegistryError(
+                    f"model version {self.version} has no shard {shard}"
+                    f" (shard count: {self.shard_count})"
+                )
+            blob_path = os.path.join(
+                self.path, _shard_dir(int(shard)), _BLOB_NAME
+            )
+            want_crc = shards["blobs"][int(shard)]["crc"]
         try:
-            with open(os.path.join(self.path, _BLOB_NAME), "rb") as f:
+            with open(blob_path, "rb") as f:
                 blob = f.read()
         except OSError as exc:
             raise RegistryError(
                 f"model version {self.version}: unreadable blob: {exc}"
             )
-        if zlib.crc32(blob) != self.manifest.get("crc"):
+        if zlib.crc32(blob) != want_crc:
             raise RegistryError(
                 f"model version {self.version}: blob CRC mismatch (torn or"
                 " corrupt); roll back to another retained version"
@@ -202,13 +226,46 @@ class ModelRegistry:
                 f"{path}: blob is {size} bytes, manifest says"
                 f" {manifest.get('blob_bytes')} (torn/truncated)"
             )
+        shards = manifest.get("shards")
+        if shards:
+            blobs = shards.get("blobs") or []
+            if len(blobs) != int(shards.get("count", -1)):
+                raise RegistryError(
+                    f"{path}: shard manifest lists {len(blobs)} blobs for"
+                    f" count {shards.get('count')}"
+                )
+            for k, entry in enumerate(blobs):
+                shard_path = os.path.join(path, _shard_dir(k), _BLOB_NAME)
+                try:
+                    shard_size = os.path.getsize(shard_path)
+                except OSError:
+                    shard_size = -1
+                if shard_size != entry.get("bytes"):
+                    raise RegistryError(
+                        f"{path}: shard {k} blob is {shard_size} bytes,"
+                        f" manifest says {entry.get('bytes')}"
+                        " (torn/truncated)"
+                    )
         return RegistryVersion(path, manifest)
 
     # -- publish -----------------------------------------------------------
-    def publish(self, blob: bytes, meta: dict | None = None) -> RegistryVersion:
+    def publish(
+        self,
+        blob: bytes,
+        meta: dict | None = None,
+        shard_blobs: list[bytes] | None = None,
+    ) -> RegistryVersion:
         """Commit ``blob`` as the next version. ``meta`` rides the manifest
         (source, instance_id, engine_params, wal_seqno, until_ms, ...) so a
         version is self-contained: deploy needs nothing but the registry.
+
+        ``shard_blobs`` adds the shard axis: blob K lands at
+        ``shard-K/model.bin`` with its own CRC in the manifest, while the
+        full blob stays at ``model.bin`` -- one generation serves both a
+        sharded fabric (each scorer shard loads only its partition) and a
+        single-process deploy, which is what makes the byte-identity A/B
+        on "the same registry generation" possible. GC is per-generation
+        (rmtree), so keep-N is unchanged.
         """
         os.makedirs(self.dir, exist_ok=True)
         tmp = os.path.join(
@@ -220,11 +277,27 @@ class ModelRegistry:
                 f.write(blob)
                 f.flush()
                 os.fsync(f.fileno())
+            shards_manifest = None
+            if shard_blobs is not None:
+                entries = []
+                for k, shard_blob in enumerate(shard_blobs):
+                    shard_dir = os.path.join(tmp, _shard_dir(k))
+                    os.makedirs(shard_dir)
+                    with open(os.path.join(shard_dir, _BLOB_NAME), "wb") as f:
+                        f.write(shard_blob)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    _fsync_dir(shard_dir)
+                    entries.append(
+                        {"bytes": len(shard_blob), "crc": zlib.crc32(shard_blob)}
+                    )
+                shards_manifest = {"count": len(shard_blobs), "blobs": entries}
             manifest_base = {
                 "format_version": REGISTRY_FORMAT_VERSION,
                 "created_at": _dt.datetime.now(_dt.timezone.utc).isoformat(),
                 "blob_bytes": len(blob),
                 "crc": zlib.crc32(blob),
+                **({"shards": shards_manifest} if shards_manifest else {}),
                 **(meta or {}),
             }
             # claim the next number with an atomic rename; a concurrent
@@ -281,6 +354,10 @@ class ModelRegistry:
                         shutil.rmtree(path, ignore_errors=True)
                 except OSError:
                     pass
+
+
+def _shard_dir(shard: int) -> str:
+    return f"shard-{int(shard)}"
 
 
 def _fsync_dir(path: str) -> None:

@@ -48,7 +48,7 @@ import socket
 import time
 from collections import deque
 
-from predictionio_tpu.serving import shmring
+from predictionio_tpu.serving import shardmap, shmring
 from predictionio_tpu.utils.http import (
     HTTPParseError,
     RequestParser,
@@ -115,22 +115,43 @@ class _Conn:
 
 
 class FrontendWorker:
-    """The single-threaded per-process serving loop around one ring."""
+    """The single-threaded per-process serving loop around one ring (or,
+    under the sharded fabric, one ring PER scorer shard plus a control
+    ring). With multiple rings the worker routes each ``POST
+    /queries.json`` frame by the query's user id --
+    ``shardmap.shard_of(user) % num_shards`` picks the owning shard's
+    ring -- while every non-query frame (and stats publication) rides the
+    LAST ring, which the fabric supervisor consumes. One ring is exactly
+    the pre-shard tier: all traffic on ring 0."""
 
     def __init__(
         self,
-        ring: shmring.RingFile,
+        rings: "shmring.RingFile | list[shmring.RingFile]",
         listener: socket.socket,
-        wake_req: shmring.Wakeup,
+        wake_reqs: "shmring.Wakeup | list[shmring.Wakeup]",
         wake_cmp: shmring.Wakeup,
         wake_stop: shmring.Wakeup,
         index: int,
         server_name: str = "pio-queryserver",
         stats_flush_s: float = 0.25,
+        rid_base: int = 0,
     ):
-        self.ring = ring
+        self.rings = (
+            list(rings) if isinstance(rings, (list, tuple)) else [rings]
+        )
+        self._wake_reqs = (
+            list(wake_reqs)
+            if isinstance(wake_reqs, (list, tuple)) else [wake_reqs]
+        )
+        if len(self._wake_reqs) != len(self.rings):
+            raise ValueError(
+                f"{len(self.rings)} ring(s) need {len(self.rings)} request"
+                f" wakeup(s), got {len(self._wake_reqs)}"
+            )
+        #: query rings = every ring but the control ring; with one ring
+        #: the single ring plays both roles (the unsharded tier)
+        self._num_shards = max(1, len(self.rings) - 1)
         self._listener = listener
-        self._wake_req = wake_req
         self._wake_cmp = wake_cmp
         self._wake_stop = wake_stop
         self.index = index
@@ -139,7 +160,10 @@ class FrontendWorker:
         self._stats_flush_s = stats_flush_s
         self.registry = MetricsRegistry()
         self._sel = selectors.DefaultSelector()
-        self._next_id = 1
+        #: rid_base keeps request ids DISJOINT across respawn generations:
+        #: the fabric reuses ring files over a respawn, so a completion
+        #: addressed to the dead generation must never alias a live rid
+        self._next_id = rid_base + 1
         #: request id -> (conn, recv_pc, deadline_pc, keep_alive)
         self._pending: dict[int, tuple] = {}
         self._draining = False
@@ -155,7 +179,8 @@ class FrontendWorker:
         self._sel.register(
             self._wake_stop.fileno(), selectors.EVENT_READ, "stop"
         )
-        self.ring.set_state(shmring.STATE_READY)
+        for ring in self.rings:
+            ring.set_state(shmring.STATE_READY)
         next_sweep = time.perf_counter() + 1.0
         while True:
             for key, _mask in self._sel.select(timeout=0.5):
@@ -184,13 +209,15 @@ class FrontendWorker:
             ):
                 break
         self._flush_stats(force=True)
-        self.ring.set_state(shmring.STATE_DONE)
+        for ring in self.rings:
+            ring.set_state(shmring.STATE_DONE)
 
     def _begin_drain(self) -> None:
         if self._draining:
             return
         self._draining = True
-        self.ring.set_state(shmring.STATE_DRAINING)
+        for ring in self.rings:
+            ring.set_state(shmring.STATE_DRAINING)
         try:
             self._sel.unregister(self._listener)
         except KeyError:
@@ -281,10 +308,12 @@ class FrontendWorker:
             return
         recv_pc = time.perf_counter()
         rid = self._alloc_id()
-        if parsed.target.split("?", 1)[0] == "/metrics":
+        path = parsed.target.split("?", 1)[0]
+        if path == "/metrics":
             # the scrape that is about to aggregate worker snapshots must
             # see THIS worker's counters current up to this very request
             self._flush_stats(force=True)
+        ring_idx = self._route_ring(parsed, path, rid)
         meta = {
             "i": rid,
             "m": parsed.method,
@@ -294,7 +323,7 @@ class FrontendWorker:
             "w": self._label,
         }
         try:
-            self.ring.requests.push(meta, parsed.body)
+            self.rings[ring_idx].requests.push(meta, parsed.body)
         except shmring.RingFull:
             self._count("pio_frontend_ring_full_total")
             # backpressure parity with the ingest pipeline's bounded
@@ -310,7 +339,25 @@ class FrontendWorker:
             conn, recv_pc, recv_pc + FORWARD_TIMEOUT_S,
             not conn.close_after,
         )
-        self._wake_req.signal()
+        self._wake_reqs[ring_idx].signal()
+
+    def _route_ring(self, parsed, path: str, rid: int) -> int:
+        """Pick the destination ring for one parsed request. Single-ring
+        deploys (the pre-shard tier) send everything to ring 0. Under the
+        sharded fabric, a query routes to its user's owning shard
+        (``shardmap.shard_of``); a query with no extractable user is
+        spread ``rid % num_shards`` (every shard answers user-less
+        queries identically: the item-side state is replicated);
+        everything else -- control routes, scrapes -- rides the LAST
+        ring to the fabric supervisor."""
+        if len(self.rings) == 1:
+            return 0
+        if parsed.method == "POST" and path == "/queries.json":
+            user = shardmap.extract_user(parsed.body)
+            if user is None:
+                return rid % self._num_shards
+            return shardmap.shard_of(user, self._num_shards)
+        return len(self.rings) - 1
 
     def _enqueue_local(
         self,
@@ -352,8 +399,12 @@ class FrontendWorker:
 
     # -- completion side ----------------------------------------------------
     def _pump_completions(self) -> None:
+        for ring in self.rings:
+            self._pump_ring(ring)
+
+    def _pump_ring(self, ring: shmring.RingFile) -> None:
         while True:
-            msg = self.ring.completions.pop()
+            msg = ring.completions.pop()
             if msg is None:
                 return
             meta, body = msg
@@ -480,7 +531,9 @@ class FrontendWorker:
             return
         self._stats_dirty = False
         self._stats_last = time.monotonic()
-        self.ring.write_stats(self.registry.snapshot())
+        # the control ring under the fabric (rings[-1] IS ring 0 on a
+        # single-ring deploy): whoever supervises reads snapshots there
+        self.rings[-1].write_stats(self.registry.snapshot())
 
 
 _HELP = {
@@ -494,13 +547,25 @@ _HELP = {
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--ring", required=True, help="ring file path")
+    ap.add_argument(
+        "--ring", required=True, action="append",
+        help="ring file path; repeat under the sharded fabric (one per"
+        " scorer shard, control ring LAST)",
+    )
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--worker", type=int, required=True)
-    ap.add_argument("--wake-req", required=True)
+    ap.add_argument(
+        "--wake-req", required=True, action="append",
+        help="request wakeup spec, one per --ring in the same order",
+    )
     ap.add_argument("--wake-cmp", required=True)
     ap.add_argument("--wake-stop", required=True)
+    ap.add_argument(
+        "--rid-base", type=int, default=0,
+        help="request-id offset (the fabric passes generation<<33 so"
+        " respawns over reused rings never alias in-flight ids)",
+    )
     ap.add_argument("--server-name", default="pio-queryserver")
     ap.add_argument("--stats-flush-s", type=float, default=0.25)
     ap.add_argument(
@@ -518,17 +583,18 @@ def main(argv: list[str] | None = None) -> int:
                 "could not pin frontend worker %d to cpu %d",
                 args.worker, args.pin_cpu,
             )
-    ring = shmring.RingFile.attach(args.ring)
+    rings = [shmring.RingFile.attach(path) for path in args.ring]
     listener = reuseport_listener(args.host, args.port)
     worker = FrontendWorker(
-        ring,
+        rings,
         listener,
-        shmring.Wakeup.from_spec(args.wake_req),
+        [shmring.Wakeup.from_spec(spec) for spec in args.wake_req],
         shmring.Wakeup.from_spec(args.wake_cmp),
         shmring.Wakeup.from_spec(args.wake_stop),
         index=args.worker,
         server_name=args.server_name,
         stats_flush_s=args.stats_flush_s,
+        rid_base=args.rid_base,
     )
     worker.serve()
     return 0

@@ -128,10 +128,12 @@ class FrontendConfig:
 
 
 class _Worker:
-    """One spawned frontend: its ring, process handle, and generation."""
+    """One spawned frontend: its ring, process handle, and generation.
+    Under the sharded fabric's ATTACHED bridges the ring belongs to a
+    frontend some other process supervises, so ``proc`` is None."""
 
     def __init__(self, index: int, generation: int, ring: shmring.RingFile,
-                 proc: subprocess.Popen):
+                 proc: subprocess.Popen | None = None):
         self.index = index
         self.generation = generation
         self.ring = ring
@@ -266,12 +268,20 @@ class ScorerBridge:
         server_name: str = "pio-queryserver",
         registry=None,
         async_query=None,
+        attach: list | None = None,
     ):
         self._router = router
         self._host = host
         self._requested_port = port
         self.config = config or FrontendConfig()
-        if self.config.workers < 1:
+        #: ATTACHED mode (the sharded fabric): ``attach`` is a list of
+        #: ``(RingFile, wake_req, wake_cmp)`` triples for rings some
+        #: OTHER process created and whose producers it supervises. The
+        #: bridge only pumps: no port reservation, no spawning, no
+        #: respawn supervision, no cpu pinning -- teardown closes this
+        #: process's mappings and stops its threads, nothing else.
+        self._attach = attach
+        if attach is None and self.config.workers < 1:
             raise ValueError("frontend workers must be >= 1")
         self._server_name = server_name
         self._registry = registry
@@ -322,6 +332,8 @@ class ScorerBridge:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ScorerBridge":
+        if self._attach is not None:
+            return self._start_attached()
         if not hasattr(socket, "SO_REUSEPORT"):
             raise RuntimeError(
                 "multi-process serving needs SO_REUSEPORT (Linux/BSD); "
@@ -383,16 +395,79 @@ class ScorerBridge:
             # orphans would hold the port after the parent dies
             self._teardown(kill=True)
             raise
-        self._consumer = threading.Thread(
-            target=self._consume, name="pio-scorer-consumer", daemon=True
-        )
-        self._consumer.start()
+        self._start_consumer()
         self._supervisor = threading.Thread(
             target=self._supervise, name="pio-scorer-supervisor", daemon=True
         )
         self._supervisor.start()
         self._gauge_workers()
         return self
+
+    def _start_consumer(self) -> None:
+        # ONE creation site for the consumer role: `_wake_pending` (and
+        # the wakeup-budget counters) are confined to this thread, and
+        # both the spawned and the attached start paths must share that
+        # confinement
+        self._consumer = threading.Thread(
+            target=self._consume, name="pio-scorer-consumer", daemon=True
+        )
+        self._consumer.start()
+
+    def _start_attached(self) -> "ScorerBridge":
+        """Start over pre-created rings: dispatcher pool + retry timer +
+        consumer, nothing that owns processes or sockets. The same
+        wake_req object may back several ring indexes (one shard's
+        request eventfd is signalled by every frontend); duplicate fds in
+        the consumer's select set are harmless, and ``Wakeup.close`` is
+        idempotent per object."""
+        for i, (ring, wake_req, wake_cmp) in enumerate(self._attach):
+            self._wakes[i] = (wake_req, wake_cmp)
+            self._workers.append(_Worker(i, ring.generation, ring))
+        n_dispatch = (
+            self.config.max_inflight
+            if self._async_query is None
+            else max(1, min(self.config.control_threads,
+                            self.config.max_inflight))
+        )
+        for k in range(n_dispatch):
+            t = threading.Thread(
+                target=self._dispatch_loop, name=f"pio-scorer-{k}",
+                daemon=True,
+            )
+            t.start()
+            self._dispatchers.append(t)
+        self._retry.start()
+        self._start_consumer()
+        return self
+
+    def _stop_attached(self) -> None:
+        with self._lock:
+            if self._stopping:
+                return
+            self._draining = True
+            self._stopping = True
+        if self._consumer is not None:
+            self._consumer.join(timeout=5.0)
+        for _ in self._dispatchers:
+            self._work.put(None)
+        for t in self._dispatchers:
+            t.join(timeout=10.0)
+        self._retry.stop()
+        # snapshot under the bridge lock: in spawned mode the supervisor
+        # swaps _workers slots on respawn under this lock (attached mode
+        # has no supervisor, but the discipline is one lock for the list)
+        with self._lock:
+            workers = list(self._workers)
+        seen: set[int] = set()
+        for w in workers:
+            with w.cmp_lock:
+                w.dead = True
+            w.ring.close()
+        for wakes in self._wakes.values():
+            for wake in wakes:
+                if id(wake) not in seen:
+                    seen.add(id(wake))
+                    wake.close()
 
     def _pin_plan(self) -> dict | None:
         """The --pin-cpus core assignment: frontends take one core each
@@ -507,7 +582,10 @@ class ScorerBridge:
         pool drains and everything is torn down. Idempotent; concurrent
         callers serialize and the second is a no-op."""
         with self._stop_lock:
-            self._stop_locked()
+            if self._attach is not None:
+                self._stop_attached()
+            else:
+                self._stop_locked()
 
     def _stop_locked(self) -> None:
         with self._lock:

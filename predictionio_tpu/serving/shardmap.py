@@ -1,0 +1,54 @@
+"""Shard routing for the sharded serving fabric.
+
+One function decides which scorer shard owns a user, and every tier --
+the frontend's ring picker, the shard process's model filter, and the
+continuous-learning loop's touched-shard delta routing -- imports it
+from here, so the partition can never skew between the process that
+routes a query and the process that holds the factors.
+
+Import-light on purpose: the frontend worker (serving/frontend.py) is a
+no-jax, no-numpy interpreter, so only stdlib may be imported here.
+
+``zlib.crc32`` rather than ``hash()``: Python string hashing is salted
+per interpreter (PYTHONHASHSEED), and the router and the shards are
+*different* interpreters -- a salted hash would route user u to shard 1
+while shard 2 holds u's factors. CRC32 is stable across processes,
+platforms, and releases, which also makes the registry's per-shard
+blobs portable between a publisher and any later deploy.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+
+__all__ = ["shard_of", "extract_user"]
+
+
+def shard_of(user_id: str, num_shards: int) -> int:
+    """The shard that owns ``user_id``'s factor rows (0-based)."""
+    if num_shards <= 1:
+        return 0
+    return zlib.crc32(str(user_id).encode("utf-8")) % num_shards
+
+
+def extract_user(body: bytes) -> str | None:
+    """The ``"user"`` field of a query body, or None.
+
+    The frontend calls this before picking a ring; a malformed body or a
+    userless query returns None and the caller falls back to any shard
+    (item-side state is replicated, so every shard answers userless
+    queries identically). Scalars are stringified exactly like the
+    scorer's own ``str(query.get("user"))`` lookups, so router and
+    model agree on the key.
+    """
+    try:
+        obj = json.loads(body)
+    except Exception:
+        return None
+    if not isinstance(obj, dict):
+        return None
+    user = obj.get("user")
+    if user is None or isinstance(user, (dict, list, bool)):
+        return None
+    return str(user)

@@ -51,6 +51,16 @@ def register(sub: argparse._SubParsersAction) -> None:
         " engine.json alsSolver param for this run",
     )
     train.add_argument(
+        "--als-feed",
+        choices=("resident", "streamed"),
+        default=None,
+        help="how ALS reads training data: 'resident' materializes rating"
+        " arrays in host memory, 'streamed' trains straight from the"
+        " snapshot's on-disk columnar chunks (needs --snapshot-mode"
+        " use/refresh; bounded host memory for catalogs bigger than RAM)."
+        " Overrides the engine.json alsFeed param for this run",
+    )
+    train.add_argument(
         "--profile",
         nargs="?",
         const="__default__",
@@ -109,6 +119,14 @@ def register(sub: argparse._SubParsersAction) -> None:
         "processes parse/validate HTTP and feed this process's scorer "
         "through shared-memory rings ('add a core' = 'add a worker'); "
         "0 (default) serves single-process",
+    )
+    deploy.add_argument(
+        "--scorer-shards", type=int, default=0, metavar="N",
+        help="sharded serving fabric: hash-partition the user factor"
+        " table across N scorer processes (item-side state replicated),"
+        " each hot-swapping per shard behind the SO_REUSEPORT frontend"
+        " tier; 0/1 (default) serves unsharded. Sizing: see"
+        " PIO_SHARD_BUDGET_BYTES in docs/operations.md",
     )
     deploy.add_argument(
         "--frontend-ring-slots", type=int, default=128, metavar="SLOTS",
@@ -211,6 +229,13 @@ def register(sub: argparse._SubParsersAction) -> None:
     retrain.add_argument(
         "--max-cycles", type=int, default=0, metavar="N",
         help="stop after N cycles (0 = until interrupted; test/bench knob)",
+    )
+    retrain.add_argument(
+        "--scorer-shards", type=int, default=0, metavar="N",
+        help="publish per-shard model blobs alongside the full blob so a"
+        " `pio deploy --scorer-shards N` fabric swaps without ever"
+        " loading the full model in one shard; fold-in republishes only"
+        " the shards whose users were touched (0 = full blob only)",
     )
     add_logging_arguments(retrain)
     retrain.set_defaults(func=cmd_retrain)
@@ -347,6 +372,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         os.environ["PIO_SNAPSHOT_DIR"] = args.snapshot_dir
     if args.als_solver:
         variant.runtime_conf["pio.als_solver"] = args.als_solver
+    if args.als_feed:
+        variant.runtime_conf["pio.als_feed"] = args.als_feed
     params = WorkflowParams(
         batch=args.batch,
         skip_sanity_check=args.skip_sanity_check,
@@ -386,6 +413,12 @@ def cmd_deploy(args: argparse.Namespace) -> int:
             f"got {args.batch_buckets!r}"
         )
     frontend = None
+    if args.scorer_shards > 1 and (args.ssl_cert or args.ssl_key):
+        raise SystemExit(
+            "Error: --scorer-shards does not support TLS "
+            "(--ssl-cert/--ssl-key); terminate TLS in front of the "
+            "frontend tier or deploy single-process"
+        )
     if args.frontend_workers > 0:
         if args.ssl_cert or args.ssl_key:
             raise SystemExit(
@@ -423,6 +456,7 @@ def cmd_deploy(args: argparse.Namespace) -> int:
             trace_sample=args.trace_sample,
             slow_query_ms=args.slow_query_ms,
             frontend=frontend,
+            scorer_shards=args.scorer_shards,
         )
     except RegistryError as exc:
         # --model-version names an exact artifact; a missing or corrupt one
@@ -451,6 +485,7 @@ def cmd_retrain(args: argparse.Namespace) -> int:
         ),
         max_cycles=args.max_cycles if args.follow else 1,
         allow_full_retrain=not args.no_full_retrain,
+        scorer_shards=args.scorer_shards,
     )
     try:
         loop = RetrainLoop(variant, config)

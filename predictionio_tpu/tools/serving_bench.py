@@ -482,6 +482,7 @@ def _measure_arms(
     from predictionio_tpu.workflow.create_server import (
         create_multiproc_query_server,
         create_query_server,
+        create_sharded_query_server,
     )
 
     # captured BEFORE any pinned arm narrows this process's mask: the
@@ -507,7 +508,16 @@ def _measure_arms(
     for label, server_kwargs in arms.items():
         server_kwargs = dict(server_kwargs)
         workers = server_kwargs.pop("frontend_workers", 0)
-        if workers:
+        shards = server_kwargs.pop("scorer_shards", 0)
+        if shards:
+            # the sharded fabric owns its scorer subprocesses end to end;
+            # there is no in-process service handle to close
+            handle = create_sharded_query_server(
+                variant, host="127.0.0.1", port=0, scorer_shards=shards,
+                frontend=workers or None, **server_kwargs,
+            )
+            service = None
+        elif workers:
             handle, service = create_multiproc_query_server(
                 variant, host="127.0.0.1", port=0, frontend=workers,
                 **server_kwargs,
@@ -527,7 +537,7 @@ def _measure_arms(
             sequential[label] = _sequential_bodies(url, users)
             responses[label] = concurrent_bodies(url)
             reports[label] = load_in_subprocess(url, requests)
-            if service.scorer_stats is not None:
+            if service is not None and service.scorer_stats is not None:
                 # the measured wakeup budget: the async arm must show
                 # <=2 wakeups/request and zero query-path dispatcher
                 # threads. Read from the served /metrics gauges -- the
@@ -543,7 +553,8 @@ def _measure_arms(
                 )
         finally:
             handle.stop()
-            service.close()
+            if service is not None:
+                service.close()
     return reports, responses, sequential
 
 
@@ -773,6 +784,118 @@ def run_multiproc_ab(
     return out
 
 
+def run_sharded_ab(
+    engine: str = "recommendation",
+    concurrency: int = 32,
+    requests: int = 2000,
+    shards: tuple = (1, 2, 4),
+    users: int | None = None,
+    items: int | None = None,
+    events: int | None = None,
+    window_ms: float = 2.0,
+    max_batch_size: int = 64,
+    frontend_workers: int = 1,
+) -> dict:
+    """The sharded serving sweep: one arm per scorer shard count. Shard
+    count 1 is the single-process ``ThreadingHTTPServer`` tier (the
+    fabric's floor is 2 -- one shard IS the unsharded server); each
+    n >= 2 arm is a full fabric: ``frontend_workers`` SO_REUSEPORT
+    frontends routing ``hash(user) % n`` over n scorer shard processes,
+    each holding one partition of the user factor table with the item
+    side replicated. Identical raw-socket load at every arm.
+
+    Batch-size-1 probe bodies must be BYTE-identical across every arm:
+    a shard scores its partition's users with the same code over the
+    same shapes as the unsharded scorer (partitioning selects rows, it
+    never changes arithmetic), so any divergence is a routing or
+    scatter bug, not drift. Coalescing probes use the equivalence check
+    (batch composition is timing-dependent per arm, same as the
+    multi-process A/B).
+
+    OpenBLAS is capped at 1 thread in this process (parent-side arms)
+    AND via ``OPENBLAS_NUM_THREADS`` for the shard children -- the
+    shard processes each load their own BLAS, and n spinning pools on a
+    small box would measure scheduler thrash, not sharding.
+    """
+    import os
+
+    from predictionio_tpu.serving.procserver import FrontendConfig
+    from predictionio_tpu.workflow.microbatch import BatchConfig
+
+    batching = BatchConfig(window_ms=window_ms, max_batch_size=max_batch_size)
+    counts = sorted(set(int(n) for n in shards if int(n) > 0))
+    arms: dict[str, dict] = {}
+    for n in counts:
+        if n == 1:
+            arms["shards_1"] = {"batching": batching}
+        else:
+            arms[f"shards_{n}"] = {
+                "batching": batching,
+                "scorer_shards": n,
+                "frontend_workers": FrontendConfig(
+                    workers=frontend_workers, spawn_timeout_s=180.0
+                ),
+            }
+    if "shards_1" not in arms:
+        # the sweep is meaningless without the unsharded baseline
+        arms = {"shards_1": {"batching": batching}, **arms}
+        counts = [1] + counts
+    prev_blas = _set_blas_threads(1)
+    prev_env = os.environ.get("OPENBLAS_NUM_THREADS")
+    os.environ["OPENBLAS_NUM_THREADS"] = "1"
+    try:
+        with _synthetic_deployment(engine, users, items, events) as (variant, sizes):
+            reports, responses, sequential = _measure_arms(
+                variant, arms, concurrency, requests,
+                {"user": "u1", "num": 10}, sizes["users"],
+                warmup=max(4 * max_batch_size, concurrency, 256),
+                client="raw",
+            )
+    finally:
+        if prev_env is None:
+            os.environ.pop("OPENBLAS_NUM_THREADS", None)
+        else:
+            os.environ["OPENBLAS_NUM_THREADS"] = prev_env
+        if prev_blas is not None:
+            _set_blas_threads(prev_blas)
+    out: dict = {
+        "engine": engine,
+        "concurrency": concurrency,
+        "requests": requests,
+        **sizes,
+        "window_ms": window_ms,
+        "max_batch_size": max_batch_size,
+        "frontend_workers": frontend_workers,
+        "shards": counts,
+        **reports,
+    }
+    seq_base = sequential["shards_1"]
+    out["responses_identical"] = all(
+        sequential[label] == seq_base for label in arms
+    )
+    base = responses["shards_1"]
+    out["responses_equivalent"] = all(
+        _responses_equivalent(a, b)
+        for label in arms
+        for a, b in zip(base, responses[label])
+    ) and all(
+        _responses_equivalent(a, b)
+        for label in arms
+        for a, b in zip(seq_base, sequential[label])
+    )
+    sp = reports["shards_1"]["qps"]
+    for label in arms:
+        if label == "shards_1" or not sp:
+            continue
+        out[f"qps_speedup_{label}"] = round(reports[label]["qps"] / sp, 2)
+    best = max(
+        (reports[label]["qps"] for label in arms if label != "shards_1"),
+        default=0.0,
+    )
+    out["qps_speedup"] = round(best / sp, 2) if sp and best else None
+    return out
+
+
 def run_trace_ab(
     engine: str = "recommendation",
     concurrency: int = 32,
@@ -946,6 +1069,12 @@ def main(argv: list[str] | None = None) -> int:
         " workers, a comma list (e.g. '1,2,4,8') sweeps exactly those",
     )
     ap.add_argument(
+        "--scorer-shards", default=None, metavar="N[,N...]",
+        help="run the sharded serving sweep instead: one arm per scorer"
+        " shard count (1 = the single-process baseline; each N>=2 arm"
+        " is a full hash-partitioned shard fabric); e.g. '1,2,4'",
+    )
+    ap.add_argument(
         "--dispatch", choices=("async", "sync", "both"), default="async",
         help="scorer dispatch model for the multi-process sweep arms:"
         " async fast path (default), the sync dispatcher pool, or both"
@@ -966,6 +1095,38 @@ def main(argv: list[str] | None = None) -> int:
                 )
             )
         )
+        return 0
+    if args.scorer_shards is not None:
+        engines = (
+            ["recommendation"] if args.engine == "both" else [args.engine]
+        )
+        try:
+            sweep = tuple(
+                int(n) for n in str(args.scorer_shards).split(",")
+                if n.strip()
+            )
+        except ValueError:
+            ap.error(
+                f"--scorer-shards must be an int or comma list, got "
+                f"{args.scorer_shards!r}"
+            )
+        if len(sweep) == 1:
+            sweep = (1,) + sweep
+        report = {
+            name: run_sharded_ab(
+                name,
+                concurrency=args.clients or 32,
+                requests=args.requests or 2000,
+                shards=sweep,
+                users=args.users,
+                items=args.items,
+                events=args.events,
+                window_ms=args.batch_window_ms,
+                max_batch_size=args.max_batch_size,
+            )
+            for name in engines
+        }
+        print(json.dumps(report))
         return 0
     if args.frontend_workers is not None:
         engines = (

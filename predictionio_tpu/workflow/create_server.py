@@ -109,12 +109,27 @@ class QueryService:
         extra_metrics_snapshots=None,
         model_version: int | None = None,
         registry=None,
+        shard: int | None = None,
+        num_shards: int = 1,
     ):
         self.variant = variant
         self.engine = engine or build_engine(variant)
         self.requested_instance_id = instance_id
         self.requested_model_version = model_version
         self._registry = registry  # lazily resolved from the variant
+        #: sharded serving fabric identity: this scorer owns the user rows
+        #: whose ``shardmap.shard_of(user) == shard`` out of ``num_shards``
+        #: partitions (item-side and replicated state stay whole). A plain
+        #: deploy is shard None / num_shards 1 and loads full models.
+        self.shard = shard
+        self.num_shards = int(num_shards or 1)
+        if self.num_shards > 1 and not (
+            isinstance(shard, int) and 0 <= shard < self.num_shards
+        ):
+            raise ValueError(
+                f"shard must be in [0, {self.num_shards}) when"
+                f" num_shards={self.num_shards}, got {shard!r}"
+            )
         self.feedback = feedback
         self.plugins = list(plugins or [])
         self.batching = BatchConfig() if batching is None else batching
@@ -185,6 +200,15 @@ class QueryService:
                 registry.set_gauge(
                     "pio_model_version", float(version),
                     help="Registry model version currently serving",
+                )
+            if self.num_shards > 1:
+                registry.set_gauge(
+                    "pio_scorer_shard_index", float(self.shard),
+                    help="This scorer's shard index in the serving fabric",
+                )
+                registry.set_gauge(
+                    "pio_scorer_shard_count", float(self.num_shards),
+                    help="Scorer shard count of the serving fabric",
                 )
             if swap_ts is not None:
                 registry.set_gauge(
@@ -279,6 +303,35 @@ class QueryService:
             self._registry = ModelRegistry.for_variant(self.variant)
         return self._registry
 
+    def _enforce_shard_budget(self, nbytes: int, what: str) -> None:
+        """``PIO_SHARD_BUDGET_BYTES``: the per-shard memory contract of the
+        sharded fabric. A shard REFUSES to materialize any model blob
+        larger than its configured budget -- the guarantee that lets
+        operators size shards below the full table: a generation with
+        per-shard blobs serves a model N times the budget because each
+        scorer only ever touches its own slice, while a fallback load of
+        the full blob fails loudly instead of silently blowing the shard's
+        memory envelope. No-op outside sharded mode or without the env."""
+        if self.num_shards <= 1:
+            return
+        import os
+
+        raw = os.environ.get("PIO_SHARD_BUDGET_BYTES", "").strip()
+        if not raw:
+            return
+        try:
+            budget = int(raw)
+        except ValueError:
+            logger.warning("ignoring non-integer PIO_SHARD_BUDGET_BYTES=%r", raw)
+            return
+        if budget > 0 and nbytes > budget:
+            raise RuntimeError(
+                f"shard {self.shard}/{self.num_shards}: {what} is"
+                f" {nbytes} bytes, over the shard budget of {budget}"
+                " (PIO_SHARD_BUDGET_BYTES); publish per-shard blobs"
+                " (scorer_shards on the retrain loop) or raise the budget"
+            )
+
     def _load_models(self) -> None:
         from predictionio_tpu.data import storage
         from predictionio_tpu.utils.platform import ensure_backend
@@ -300,10 +353,13 @@ class QueryService:
             (instance.runtime_conf or {}).get("pio.platform"), fallback=True
         )
         blob_record = storage.get_model_data_models().get(instance.id)
+        blob = blob_record.models if blob_record else None
+        if blob is not None:
+            self._enforce_shard_budget(len(blob), f"instance {instance.id} blob")
         ctx = RuntimeContext(instance.runtime_conf)
         models = self.engine.prepare_deploy(
-            ctx, engine_params, instance.id,
-            blob_record.models if blob_record else None,
+            ctx, engine_params, instance.id, blob,
+            shard=self.shard, num_shards=self.num_shards,
         )
         algorithms = self.engine._algorithms(engine_params)
         serving = self.engine.serving(engine_params)
@@ -347,7 +403,24 @@ class QueryService:
                 f"model registry is empty under {registry.dir}; run"
                 " `pio train` or `pio retrain` first"
             )
-        blob = entry.load_blob()  # CRC-verified
+        shard_filter: int | None = None
+        if self.num_shards > 1 and entry.shard_count == self.num_shards:
+            # the generation was published with matching per-shard blobs:
+            # load ONLY this shard's slice -- the fabric's memory contract
+            blob = entry.load_blob(shard=self.shard)  # CRC-verified
+        else:
+            if self.num_shards > 1:
+                logger.info(
+                    "version %d has %d shard blob(s) for a %d-shard"
+                    " deploy; loading the full blob and partitioning"
+                    " in-process", entry.version, entry.shard_count,
+                    self.num_shards,
+                )
+                shard_filter = self.shard
+            blob = entry.load_blob()  # CRC-verified
+        self._enforce_shard_budget(
+            len(blob), f"registry version {entry.version} blob"
+        )
         params_obj = entry.engine_params_obj
         engine_params = (
             EngineParams.from_json_obj(params_obj)
@@ -361,7 +434,8 @@ class QueryService:
         )
         ctx = RuntimeContext(self.variant.runtime_conf)
         models = self.engine.prepare_deploy(
-            ctx, engine_params, entry.instance_id or "", blob
+            ctx, engine_params, entry.instance_id or "", blob,
+            shard=shard_filter, num_shards=self.num_shards,
         )
         algorithms = self.engine._algorithms(engine_params)
         serving = self.engine.serving(engine_params)
@@ -421,6 +495,10 @@ class QueryService:
                     "buckets": list(self.batching.buckets),
                 },
             }
+            if self.num_shards > 1:
+                body["shard"] = {
+                    "shard": self.shard, "numShards": self.num_shards,
+                }
             if self.frontend_info is not None:
                 body["frontend"] = self.frontend_info
             return Response(200, body)
@@ -1058,19 +1136,92 @@ def create_multiproc_query_server(
     return MultiprocServiceHandle(bridge, service), service
 
 
+def create_sharded_query_server(
+    variant: EngineVariant,
+    host: str = "0.0.0.0",
+    port: int = DEFAULT_PORT,
+    scorer_shards: int = 2,
+    frontend=None,
+    model_version: int | None = None,
+    instance_id: str | None = None,
+    batching=None,
+):
+    """The sharded serving fabric: ``scorer_shards`` scorer processes,
+    each holding one hash partition of the user factor table (item-side
+    state replicated), behind the same ``SO_REUSEPORT`` frontend tier.
+    Returns an unstarted ``ShardFabric`` with the
+    ``start()/stop()/port`` surface of :class:`MultiprocServiceHandle`.
+    """
+    from predictionio_tpu.serving.fabric import ShardFabric
+    from predictionio_tpu.serving.procserver import FrontendConfig
+
+    if isinstance(frontend, int):
+        frontend = FrontendConfig(workers=frontend)
+    return ShardFabric(
+        variant,
+        host=host,
+        port=port,
+        num_shards=scorer_shards,
+        frontend=frontend,
+        model_version=model_version,
+        instance_id=instance_id,
+        batch_window_ms=batching.window_ms if batching else None,
+        max_batch_size=batching.max_batch_size if batching else None,
+    )
+
+
 def run_query_server(
     variant: EngineVariant,
     host: str = "0.0.0.0",
     port: int = DEFAULT_PORT,
     frontend_workers: int = 0,
     frontend=None,
+    scorer_shards: int = 0,
     **kw,
 ) -> None:
     """Blocking entry point used by ``pio deploy``. With
     ``frontend_workers`` > 0 (or an explicit ``frontend`` config) the
     server runs as the multi-process tier: N ``SO_REUSEPORT`` frontend
     processes feeding this process's scorer through shared-memory rings.
+    ``scorer_shards`` > 1 instead runs the sharded fabric: the user
+    factor table hash-partitioned across that many scorer processes.
     """
+    if scorer_shards > 1:
+        if kw.pop("ssl_cert", None) or kw.pop("ssl_key", None):
+            raise ValueError(
+                "--scorer-shards does not support --ssl-cert/--ssl-key;"
+                " terminate TLS in front of the frontend tier"
+            )
+        if kw.pop("feedback", None) is not None:
+            raise ValueError(
+                "--scorer-shards does not support --feedback yet;"
+                " run the feedback loop against an unsharded deploy"
+            )
+        dropped = {
+            k: v
+            for k in ("tracing", "trace_sample", "slow_query_ms")
+            if (v := kw.pop(k, None)) is not None
+        }
+        if dropped:
+            logger.info(
+                "sharded deploy: shard processes use their own defaults"
+                " for %s", sorted(dropped),
+            )
+        fabric = create_sharded_query_server(
+            variant, host, port, scorer_shards=scorer_shards,
+            frontend=frontend, **kw,
+        )
+        fabric.start()
+        print(
+            f"Query Server listening on http://{host}:{fabric.port}"
+            f" ({scorer_shards} scorer shard(s),"
+            f" {fabric.config.workers} frontend worker(s))"
+        )
+        try:
+            fabric.wait()
+        finally:
+            fabric.stop()
+        return
     if frontend_workers or frontend is not None:
         from predictionio_tpu.serving.procserver import FrontendConfig
 

@@ -312,6 +312,43 @@ class TestReplayRecommendation:
         ))
         assert "coldstart" in by_user
 
+    def test_replay_snapshot_mode_does_zero_sql(
+        self, storage_env, tmp_path, monkeypatch
+    ):
+        """``pio eval --replay --snapshot-mode use`` trains its prefix
+        from the pinned snapshot generation's memmaps: after the first
+        run builds the snapshot, a rerun's entire replay (prefix training
+        included) touches no SQL scan -- and reports identically to the
+        direct-store read."""
+        from predictionio_tpu.eval.replay import run_replay_eval
+        from predictionio_tpu.models.recommendation.engine import (
+            RecommendationDataSource,
+        )
+
+        _, boundary, _ = timed_movie_app(storage_env)
+        plain = load_variant(write_variant(tmp_path))
+        baseline = run_replay_eval(
+            plain, split_time=boundary, retrieval_guard=False
+        )
+        snapped = load_variant(write_variant(tmp_path))
+        snapped.runtime_conf["pio.snapshot_mode"] = "use"
+        snapped.runtime_conf["pio.snapshot_dir"] = str(tmp_path / "snaps")
+        first = run_replay_eval(
+            snapped, split_time=boundary, retrieval_guard=False
+        )
+        # the generation is pinned now: poison the direct scan and rerun
+        def no_sql(self):
+            raise AssertionError(
+                "replay under --snapshot-mode use hit the SQL scan"
+            )
+
+        monkeypatch.setattr(RecommendationDataSource, "_read", no_sql)
+        second = run_replay_eval(
+            snapped, split_time=boundary, retrieval_guard=False
+        )
+        assert first["metrics"] == second["metrics"] == baseline["metrics"]
+        assert first["split"] == second["split"] == baseline["split"]
+
     def test_responses_match_live_query_server(self, storage_env, tmp_path):
         """Seen-filter parity: the replay responses byte-match a live
         /queries.json server deployed from a model trained on the same

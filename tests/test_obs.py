@@ -1139,11 +1139,50 @@ pio_serving_batch_size_count %d
         frame = render([stats], [snap(102.0, 200, 150)])
         assert "WKR" in frame and "WAKE" in frame
         row = next(l for l in frame.splitlines() if "http://x:1" in l)
-        # WKR sits 5th from the end: the WAKE (scorer wakeups/request)
-        # and continuous-learning columns (MODEL/SWAP/LAG, dashes here)
-        # landed after it
-        assert row.split()[-5] == "2"
+        # WKR sits 6th from the end: SHARD (dash here -- not a fabric),
+        # WAKE (scorer wakeups/request) and the continuous-learning
+        # columns (MODEL/SWAP/LAG, dashes here) landed after it
+        assert row.split()[-6] == "2"
+        assert row.split()[-5] == "-"  # SHARD: unsharded service
         assert row.split()[-4] == "2.0"  # the measured wakeup budget
+
+    def test_shard_fabric_stats_and_render(self):
+        """The shard fabric's gauges reach the `pio top` view: shard
+        count in the SHARD column, and MODEL aggregated as the max over
+        the per-shard ``pio_model_version{shard=}`` series."""
+        from predictionio_tpu.obs.top import (
+            compute_stats,
+            parse_prometheus,
+            render,
+        )
+
+        text = (
+            "pio_frontend_workers 1\n"
+            "pio_scorer_shard_count 4\n"
+            'pio_model_version{shard="0"} 7\n'
+            'pio_model_version{shard="1"} 7\n'
+            'pio_model_version{shard="2"} 6\n'
+            'pio_model_version{shard="3"} 7\n'
+        )
+
+        def snap(t):
+            return {
+                "url": "http://x:1",
+                "time": t,
+                "metrics": parse_prometheus(text),
+                "traces": None,
+            }
+
+        stats = compute_stats(snap(100.0), snap(102.0))
+        assert stats["scorer_shards"] == 4
+        # mid-swap skew: MODEL shows the leading version (max), bounded
+        # to one swap window by the fabric's per-shard protocol
+        assert stats["model_version"] == 7
+        frame = render([stats], [snap(102.0)])
+        assert "SHARD" in frame
+        row = next(l for l in frame.splitlines() if "http://x:1" in l)
+        assert row.split()[-5] == "4"  # SHARD
+        assert row.split()[-3] == "7"  # MODEL
 
     def test_parse_prometheus(self):
         from predictionio_tpu.obs.top import parse_prometheus

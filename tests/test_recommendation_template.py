@@ -179,6 +179,65 @@ class TestRecommendationEngine:
         with pytest.raises(ValueError, match="seenFilter"):
             engine.train(ctx, make(reader="streaming", seenFilter="model"))
 
+    def test_als_feed_streamed_trains_from_snapshot(
+        self, movie_app, tmp_path, monkeypatch
+    ):
+        """``alsFeed: streamed`` (and its ``pio train --als-feed``
+        runtime-conf override) routes the streaming preparator through
+        ``reader.snapshot_streamed_als_data``: training consumes the
+        snapshot's disk block store via ALX device-resident epochs and
+        the factors match the resident feed bit-for-bit at equal
+        shapes."""
+        from predictionio_tpu.parallel import reader as reader_mod
+
+        engine = engine_factory()
+        conf = {
+            "pio.snapshot_mode": "use",
+            "pio.snapshot_dir": str(tmp_path / "snaps"),
+        }
+
+        def make(als_feed=None):
+            obj = {
+                "datasource": {"params": {"appName": "MovieApp",
+                                          "eventNames": ["rate"],
+                                          "reader": "streaming"}},
+                "algorithms": [{"name": "als", "params": {
+                    "rank": 8, "numIterations": 6, "lambda": 0.05,
+                    "seed": 3}}],
+            }
+            if als_feed:
+                obj["preparator"] = {"params": {"alsFeed": als_feed}}
+            return EngineParams.from_json_obj(obj)
+
+        calls = []
+        orig = reader_mod.snapshot_streamed_als_data
+
+        def spy(*args, **kwargs):
+            calls.append(1)
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(
+            reader_mod, "snapshot_streamed_als_data", spy
+        )
+        resident = engine.train(RuntimeContext(conf), make())[0]
+        assert not calls  # the default feed never touches the block store
+        streamed = engine.train(
+            RuntimeContext(conf), make(als_feed="streamed")
+        )[0]
+        assert len(calls) == 1, "alsFeed=streamed bypassed the block store"
+        np.testing.assert_array_equal(
+            streamed.als.user_factors, resident.als.user_factors
+        )
+        np.testing.assert_array_equal(
+            streamed.als.item_factors, resident.als.item_factors
+        )
+        # `pio train --als-feed streamed` wins over the engine param
+        conf_cli = dict(conf, **{"pio.als_feed": "streamed"})
+        engine.train(RuntimeContext(conf_cli), make())
+        assert len(calls) == 2
+        with pytest.raises(ValueError, match="alsFeed"):
+            engine.train(RuntimeContext(conf), make(als_feed="bogus"))
+
     def test_live_filter_downgrades_for_eval_folds(self, movie_app):
         """pio eval with seenFilter live: the held-out events still exist
         in the store, so a live read would -inf every 'actual' item and
