@@ -501,6 +501,34 @@ def secondary_main(result_path: str) -> None:
             "config": "#7 ingest_eps (32 writers, sqlite, fsync=always)",
         }
 
+    def ingest_partitioned_eps():
+        """#17: partitioned WAL ingest scaling -- the #7 group-commit load
+        re-driven at wal-partitions 1/2/4 (eps per P, scaling vs P=1),
+        plus a P=4 SIGKILL-and-replay cycle proving exactly-once per
+        partition with zero cross-partition routing drift. Storage-layer
+        only, like #7. Full sweep (1,2,4,8): `python -m
+        predictionio_tpu.tools.ingest_bench --wal-partitions 1,2,4,8`."""
+        from predictionio_tpu.tools.ingest_bench import run_sweep
+
+        rep = run_sweep(
+            partitions=(1, 2, 4), clients=32, events_per_client=25,
+            crash_partitions=4, crash_events=150,
+        )
+        out = {
+            "monotonic": rep["monotonic"],
+            "crash_exactly_once": rep["crash_cycle"]["exactly_once"],
+            "crash_replayed_per_partition": rep["crash_cycle"][
+                "replayed_per_partition"
+            ],
+            "crash_misrouted": rep["crash_cycle"]["misrouted"],
+            "config": "#17 ingest_partitioned_eps (32 writers, sqlite,"
+            " fsync=always, P in 1/2/4, crash at P=4)",
+        }
+        for p, arm in rep["partitions"].items():
+            out[f"eps_p{p}"] = arm["eps"]
+            out[f"scaling_p{p}"] = arm["scaling_vs_first"]
+        return out
+
     def train_data_eps():
         """#8: training-data extraction events/sec, cold two-scan SQL read
         vs columnar-snapshot memmap replay (sqlite), plus the
@@ -887,6 +915,36 @@ def secondary_main(result_path: str) -> None:
             " sqlite, rank 8)",
         }
 
+    def online_freshness_loaded():
+        """#18: the #13 fold-in freshness probe re-run against a P=4
+        partitioned WAL while background writers keep a sustained durable
+        ingest stream flowing (every probe competes with ~10x its own
+        write rate): the partitioned follower must keep merged fold-ins
+        fresh under write pressure. CPU-only like #13."""
+        if tpu:
+            return {
+                "skipped": "CPU-only phase (TPU child shares an already-"
+                "initialized backend)"
+            }
+        from predictionio_tpu.tools.retrain_bench import run_ab
+
+        rep = run_ab(
+            events=1_500, users=50, items=25, rank=8, iterations=2,
+            probes=3, load_clients=1, full_retrain_arm=False,
+            wal_partitions=4, ingest_load_clients=2,
+        )
+        fold = rep["foldin"]
+        return {
+            "online_freshness_loaded_seconds": fold["freshness_s_median"],
+            "online_freshness_loaded_seconds_max": fold["freshness_s_max"],
+            "probe_timeouts": fold["timeouts"],
+            "load_errors": fold["load_errors"],
+            "ingest_load_events": fold["ingest_load_events"],
+            "ingest_load_errors": fold["ingest_load_errors"],
+            "config": "#18 online_freshness_loaded (3 probes, P=4,"
+            " 2 ingest load writers, sqlite, rank 8)",
+        }
+
     def als_stream():
         """#14: device-resident streamed epochs vs the resident feed at an
         equal (small) shape: edges/sec per arm, bit-identity of the
@@ -979,6 +1037,8 @@ def secondary_main(result_path: str) -> None:
     phase("als_stream", als_stream)
     phase("analysis_findings", analysis_findings)
     phase("online_freshness_seconds", online_freshness)
+    phase("ingest_partitioned_eps", ingest_partitioned_eps)
+    phase("online_freshness_loaded_seconds", online_freshness_loaded)
 
 
 def child_main(mode: str, result_path: str) -> None:

@@ -40,10 +40,11 @@ from predictionio_tpu.data.ingest import (
     IngestConfig,
     IngestOverload,
     IngestPipeline,
-    replay_wal_into_storage,
+    PartitionedIngestPipeline,
+    replay_partitioned_wal,
 )
 from predictionio_tpu.data.storage.base import AccessKey
-from predictionio_tpu.data.wal import WriteAheadLog
+from predictionio_tpu.data.wal import PartitionedWal
 from predictionio_tpu.data import webhooks as webhook_registry
 from predictionio_tpu.utils.http import (
     Request,
@@ -121,15 +122,17 @@ class EventService:
         tracing: bool | None = None,
         trace_sample: float | None = None,
         slow_commit_ms: float | None = None,
+        extra_metrics_snapshots=None,
     ):
         self.stats_enabled = stats
         self.stats = _Stats()
         self.plugins = list(plugins or [])
-        self.ingest: IngestPipeline | None = None
-        self._wal: WriteAheadLog | None = None
+        self.ingest: PartitionedIngestPipeline | IngestPipeline | None = None
+        self._wal: PartitionedWal | None = None
         self.router, self.metrics = instrumented_router(
             before_scrape=self._before_scrape, tracing=tracing,
             trace_sample=trace_sample,
+            extra_snapshots=extra_metrics_snapshots,
         )
         if slow_commit_ms is not None:
             # one summary line per group commit over the threshold
@@ -152,20 +155,23 @@ class EventService:
     # -- ingest pipeline lifecycle ------------------------------------------
     def _start_ingest(self, config: IngestConfig) -> None:
         """WAL + group-commit mode: replay the un-flushed tail left by a
-        previous crash, then start the background writer."""
-        self._wal = WriteAheadLog(
+        previous crash (exactly-once PER PARTITION -- each stream has its
+        own checkpoint), then start the partition writers. P=1 opens the
+        flat single-log layout, so upgrades replay old logs unchanged."""
+        self._wal = PartitionedWal(
             config.resolved_wal_dir(),
+            partitions=config.wal_partitions,
             segment_bytes=config.segment_bytes,
             fsync_policy=config.fsync_policy,
         )
-        replayed = replay_wal_into_storage(
+        replayed = replay_partitioned_wal(
             self._wal, tracer=self.router.tracer
         )
         if replayed:
             logging.getLogger("pio.ingest").warning(
                 "replayed %d WAL record(s) into the event store", replayed
             )
-        self.ingest = IngestPipeline(
+        self.ingest = PartitionedIngestPipeline(
             self._wal,
             queue_size=config.queue_size,
             group_commit_ms=config.group_commit_ms,
@@ -189,12 +195,28 @@ class EventService:
             self._wal = None
 
     def _before_scrape(self, registry) -> None:
-        if self.ingest is not None:
+        ingest = self.ingest
+        if ingest is not None:
             registry.set_gauge(
                 "pio_ingest_queue_depth",
-                float(self.ingest.depth()),
+                float(ingest.depth()),
                 help="Events parked in the ingest queue awaiting group commit",
             )
+            partitions = getattr(ingest, "partitions", 1)
+            registry.set_gauge(
+                "pio_ingest_partitions",
+                float(partitions),
+                help="WAL partition count (hash-sharded durability streams)",
+            )
+            if hasattr(ingest, "depth_of"):
+                for k in range(partitions):
+                    registry.set_gauge(
+                        "pio_ingest_partition_depth",
+                        float(ingest.depth_of(k)),
+                        labels={"part": str(k)},
+                        help="Events parked per WAL partition awaiting"
+                        " group commit",
+                    )
         wal = self._wal
         if wal is not None:
             registry.set_counter(
@@ -555,6 +577,82 @@ def create_event_server(
     return ServiceThread(server, on_stop=service.shutdown_ingest)
 
 
+class MultiprocEventServerHandle:
+    """Lifecycle wrapper for the multi-process event-server tier: M
+    SO_REUSEPORT frontend workers (the PR-8 serving pattern, reused
+    verbatim -- ``ScorerBridge`` is generic over any Router) feeding this
+    process's ingest pipeline through the dispatcher pool. Defined here,
+    NOT in ``workflow/create_server`` -- that module drags in the jax
+    engine stack, which an event server must never import."""
+
+    def __init__(self, bridge, service: EventService):
+        self._bridge = bridge
+        self.service = service
+
+    @property
+    def port(self) -> int | None:
+        return self._bridge.port
+
+    def stop(self) -> None:
+        """Drain frontends FIRST (no new submits can arrive once the
+        workers are gone), then drain the group-commit queues -- the
+        reverse order would strand in-flight requests on a stopped
+        pipeline's 429s mid-drain."""
+        self._bridge.stop()
+        self.service.shutdown_ingest()
+
+
+def create_multiproc_event_server(
+    host: str = "0.0.0.0",
+    port: int = DEFAULT_PORT,
+    stats: bool = False,
+    plugins: list[EventServerPlugin] | None = None,
+    ingest_config: IngestConfig | None = None,
+    tracing: bool | None = None,
+    trace_sample: float | None = None,
+    slow_commit_ms: float | None = None,
+    frontend_config=None,
+) -> MultiprocEventServerHandle:
+    """Multi-process event server: frontends parse HTTP and forward over
+    shared-memory rings; this process runs the WAL partitions. Dispatch
+    is the SYNC pool (``async_query=None``): an ingest request legitimately
+    parks its dispatcher thread on the group-commit future, so
+    ``max_inflight`` is the tier's ingest-concurrency bound.
+
+    The returned handle is started; callers print/wait/stop."""
+    from predictionio_tpu.serving.procserver import (
+        FrontendConfig,
+        ScorerBridge,
+    )
+
+    if frontend_config is None:
+        frontend_config = FrontendConfig(dispatch="sync", max_inflight=32)
+    # late-bound cell: the service's /metrics scrape merges worker
+    # snapshots, but the bridge needs the service's router first
+    bridge_cell: list = []
+
+    def worker_snapshots() -> list[dict]:
+        return bridge_cell[0].metric_snapshots() if bridge_cell else []
+
+    service = EventService(
+        stats=stats, plugins=plugins, ingest_config=ingest_config,
+        tracing=tracing, trace_sample=trace_sample,
+        slow_commit_ms=slow_commit_ms,
+        extra_metrics_snapshots=worker_snapshots,
+    )
+    bridge = ScorerBridge(
+        service.router, host, port, frontend_config,
+        server_name="pio-eventserver", registry=service.metrics,
+    )
+    bridge_cell.append(bridge)
+    try:
+        bridge.start()
+    except Exception:
+        service.shutdown_ingest()
+        raise
+    return MultiprocEventServerHandle(bridge, service)
+
+
 def run_event_server(
     host: str = "0.0.0.0",
     port: int = DEFAULT_PORT,
@@ -566,8 +664,44 @@ def run_event_server(
     tracing: bool | None = None,
     trace_sample: float | None = None,
     slow_commit_ms: float | None = None,
+    frontend_workers: int = 0,
 ) -> None:
     """Blocking entry point used by ``pio eventserver``."""
+    if frontend_workers > 0:
+        if ssl_cert or ssl_key:
+            # PR-8 precedent: TLS terminates in the worker processes or
+            # nowhere; the rings carry parsed frames, not TLS streams
+            raise ValueError(
+                "--frontend-workers does not support --ssl-cert/--ssl-key;"
+                " terminate TLS in front of the frontends"
+            )
+        from predictionio_tpu.serving.procserver import FrontendConfig
+
+        handle = create_multiproc_event_server(
+            host=host, port=port, stats=stats, plugins=plugins,
+            ingest_config=ingest_config, tracing=tracing,
+            trace_sample=trace_sample, slow_commit_ms=slow_commit_ms,
+            frontend_config=FrontendConfig(
+                workers=frontend_workers, dispatch="sync", max_inflight=32,
+            ),
+        )
+        service = handle.service
+        mode = "wal" if service.ingest is not None else "sync"
+        parts = getattr(service.ingest, "partitions", 1)
+        print(
+            f"Event Server listening on http://{host}:{handle.port}"
+            f" (stats={'on' if stats else 'off'}, ingest={mode},"
+            f" wal-partitions={parts},"
+            f" frontend-workers={frontend_workers},"
+            f" plugins={len(service.plugins)})"
+        )
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            handle.stop()
+        return
     service = EventService(
         stats=stats, plugins=plugins, ingest_config=ingest_config,
         tracing=tracing, trace_sample=trace_sample,

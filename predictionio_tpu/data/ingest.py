@@ -23,6 +23,16 @@ cycle is exactly-once -- nothing acked is lost, nothing is double-applied.
 additionally requires the event store's own commits to be durable --
 postgres/mysql defaults, or sqlite with ``SYNCHRONOUS=FULL`` -- because
 the checkpoint advances once the store COMMITS, not once it fsyncs.)
+
+With ``wal_partitions`` P > 1, :class:`PartitionedIngestPipeline` runs P
+of these single-writer pipelines side by side, one per WAL partition
+(``data/wal.PartitionedWal``), routing each event by the stable entity
+hash shared with the serving tier (``utils/stablehash``). Per-entity
+ordering holds (one entity -> one partition -> one writer thread) while
+the P fsync streams proceed in parallel -- the group-commit latency stops
+being a serial bottleneck. Every durability invariant above applies
+per partition unchanged; there is deliberately NO cross-partition
+protocol to reason about.
 """
 
 from __future__ import annotations
@@ -36,8 +46,9 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 
 from predictionio_tpu.data.event import Event
-from predictionio_tpu.data.wal import WriteAheadLog
+from predictionio_tpu.data.wal import PartitionedWal, WriteAheadLog
 from predictionio_tpu.obs.trace import NULL_TRACER, current_context
+from predictionio_tpu.utils.stablehash import stable_bucket
 
 logger = logging.getLogger("pio.ingest")
 
@@ -56,6 +67,7 @@ class IngestConfig:
     fsync_policy: str = "always"  # always | interval | never
     wal_dir: str | None = None    # default: $PIO_FS_BASEDIR/wal
     segment_bytes: int = 64 << 20
+    wal_partitions: int = 1       # hash-sharded durability streams
 
     def resolved_wal_dir(self) -> str:
         if self.wal_dir:
@@ -127,6 +139,7 @@ class IngestPipeline:
         max_batch: int = 256,
         metrics=None,
         tracer=None,
+        part: int | None = None,
     ):
         if l_events is None:
             from predictionio_tpu.data import storage as storage_registry
@@ -139,6 +152,12 @@ class IngestPipeline:
         self.group_commit_s = group_commit_ms / 1000.0
         self.max_batch = max_batch
         self.metrics = metrics
+        # partition index when owned by a PartitionedIngestPipeline: names
+        # the writer thread and labels this writer's commit metrics with
+        # {part=}; None = standalone single-stream pipeline (no labels, the
+        # pre-partitioning exposition unchanged)
+        self.part = part
+        self._part_labels = None if part is None else {"part": str(part)}
         self._stopping = threading.Event()
         # serializes the stopping-check-then-enqueue in submit() against
         # stop()'s flag set: once the flag is visible, no further enqueue can
@@ -146,7 +165,10 @@ class IngestPipeline:
         # future is ever stranded unresolved
         self._submit_gate = threading.Lock()
         self._thread = threading.Thread(
-            target=self._writer_loop, name="pio-ingest-writer", daemon=True
+            target=self._writer_loop,
+            name="pio-ingest-writer" if part is None
+            else f"pio-ingest-writer-p{part}",
+            daemon=True,
         )
         self.retry_after_s = max(1.0, group_commit_ms / 1000.0)
         self.storage_errors = 0
@@ -370,17 +392,20 @@ class IngestPipeline:
             return
         self.metrics.inc(
             "pio_ingest_events_total",
+            labels=self._part_labels,
             amount=float(len(batch)),
             help="Events committed through the ingest pipeline",
         )
         self.metrics.observe(
             "pio_ingest_commit_seconds",
             seconds,
+            labels=self._part_labels,
             help="Group-commit latency (WAL sync + storage flush)",
         )
         self.metrics.observe(
             "pio_ingest_batch_size",
             float(len(batch)),
+            labels=self._part_labels,
             buckets=BATCH_BUCKETS,
             help="Events per group commit",
         )
@@ -388,6 +413,7 @@ class IngestPipeline:
             self.metrics.set_counter(
                 "pio_ingest_storage_errors_total",
                 float(self.storage_errors),
+                labels=self._part_labels,
                 help="Batches whose storage flush failed (recovered via WAL replay)",
             )
 
@@ -463,3 +489,117 @@ def replay_wal_into_storage(
             attrs={"records_total": count},
         )
     return count
+
+
+def partition_of(event: Event, partitions: int) -> int:
+    """The WAL partition that owns ``event`` -- the ONE routing rule.
+
+    Buckets by ``entity_id`` with the exact hash the serving fabric
+    shards user factors by (``serving/shardmap.shard_of`` is the same
+    function): every record an entity ever writes lands in one
+    partition, so per-entity ordering is preserved by that partition's
+    single writer thread, and the ingest stream for an entity lives
+    where the serving tier expects its state.
+    """
+    return stable_bucket(event.entity_id, partitions)
+
+
+def replay_partitioned_wal(
+    wal: PartitionedWal, l_events=None, batch_size: int = 500, tracer=None
+) -> int:
+    """Startup replay over every partition; returns total records
+    examined. Each partition replays against its OWN checkpoint and
+    advances it independently (exactly-once per partition, the
+    single-log contract of :func:`replay_wal_into_storage` applied P
+    times); records cannot cross partitions because replay never
+    re-routes -- it re-applies each partition's log verbatim."""
+    return sum(
+        replay_wal_into_storage(
+            part, l_events=l_events, batch_size=batch_size, tracer=tracer
+        )
+        for part in wal.parts
+    )
+
+
+class PartitionedIngestPipeline:
+    """P single-writer :class:`IngestPipeline` streams behind one submit.
+
+    Each partition owns a complete pipeline -- bounded queue, writer
+    thread, WAL stream, retry parking -- so the fsync/storage-flush
+    stages of different partitions overlap freely; the only shared code
+    path is the stateless hash in :func:`partition_of`. The per-partition
+    queues split the configured ``queue_size`` so total buffered work
+    (and thus worst-case replay) stays bounded by the same knob as the
+    single-stream pipeline.
+    """
+
+    def __init__(
+        self,
+        wal: PartitionedWal,
+        l_events=None,
+        queue_size: int = 2048,
+        group_commit_ms: float = 5.0,
+        max_batch: int = 256,
+        metrics=None,
+        tracer=None,
+    ):
+        self.wal = wal
+        self.partitions = wal.partitions
+        per_part_queue = max(64, queue_size // self.partitions)
+        # P=1 passes part=None: metrics stay unlabeled and the writer
+        # thread keeps its pre-partitioning name -- the degenerate case is
+        # observably identical to the original single-stream pipeline
+        self.pipes: list[IngestPipeline] = [
+            IngestPipeline(
+                wal.part(k),
+                l_events=l_events,
+                queue_size=per_part_queue,
+                group_commit_ms=group_commit_ms,
+                max_batch=max_batch,
+                metrics=metrics,
+                tracer=tracer,
+                part=None if self.partitions == 1 else k,
+            )
+            for k in range(self.partitions)
+        ]
+
+    # -- request side -------------------------------------------------------
+    def start(self) -> "PartitionedIngestPipeline":
+        for pipe in self.pipes:
+            pipe.start()
+        return self
+
+    def submit(self, event: Event, app_id: int, channel_id: int | None) -> Future:
+        return self.pipes[partition_of(event, self.partitions)].submit(
+            event, app_id, channel_id
+        )
+
+    def depth(self) -> int:
+        return sum(pipe.depth() for pipe in self.pipes)
+
+    def depth_of(self, part: int) -> int:
+        return self.pipes[part].depth()
+
+    @property
+    def retry_after_s(self) -> float:
+        return max(pipe.retry_after_s for pipe in self.pipes)
+
+    @property
+    def storage_errors(self) -> int:
+        return sum(pipe.storage_errors for pipe in self.pipes)
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop every partition writer CONCURRENTLY: a drain is dominated
+        by fsync + storage-flush latency, and serializing P drains would
+        multiply shutdown time by exactly the factor the partitions were
+        added to divide."""
+        stoppers = [
+            threading.Thread(
+                target=pipe.stop, kwargs={"drain": drain, "timeout": timeout}
+            )
+            for pipe in self.pipes
+        ]
+        for t in stoppers:
+            t.start()
+        for t in stoppers:
+            t.join(timeout=timeout + 5.0)

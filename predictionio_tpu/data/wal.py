@@ -31,15 +31,33 @@ Fsync policy trade-off (``always`` | ``interval`` | ``never``):
   post-crash loss window to that interval;
 - ``never``   -- OS page cache only; survives process death, not host
   death.
+
+Partitioned layout (``wal-partitions P`` with P > 1) shards the log by
+entity hash into P fully independent sub-logs, each with its own seqno
+space, segment files, checkpoint, and fsync stream::
+
+    wal.parts                      partition count (the layout marker)
+    part-00000/wal-...log          partition 0: a complete log as above
+    part-00000/wal.ckpt
+    part-00001/...
+
+P = 1 is the degenerate case: no marker, no subdirectories -- the flat
+single-log layout above, byte-for-byte what earlier releases wrote, so
+old logs replay unchanged. :func:`resolve_partitions` adopts whatever
+layout is on disk over the requested count (a WAL's partition count is
+fixed at birth; re-routing a live log would strand records).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import threading
 import time
 import zlib
+
+logger = logging.getLogger("pio.wal")
 
 #: frame header: payload length, crc32(seqno_bytes + payload), seqno
 _FRAME = struct.Struct("<IIQ")
@@ -53,6 +71,12 @@ FSYNC_POLICIES = ("always", "interval", "never")
 _SEGMENT_PREFIX = "wal-"
 _SEGMENT_SUFFIX = ".log"
 _CHECKPOINT_FILE = "wal.ckpt"
+_PARTS_FILE = "wal.parts"
+_PART_DIR_PREFIX = "part-"
+
+
+def _part_dir_name(index: int) -> str:
+    return f"{_PART_DIR_PREFIX}{index:05d}"
 
 
 def _segment_name(first_seqno: int) -> str:
@@ -166,6 +190,85 @@ def iter_log_records(
             if upto_seqno is not None and seqno > upto_seqno:
                 return
             yield seqno, payload
+
+
+def _flat_log_exists(directory: str) -> bool:
+    """True when ``directory`` holds a single-partition log: segment files
+    or a checkpoint directly at the root (the pre-partitioning layout)."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return False
+    for name in entries:
+        if name == _CHECKPOINT_FILE or _segment_first_seqno(name) is not None:
+            return True
+    return False
+
+
+def _marker_partitions(directory: str) -> int | None:
+    """The ``wal.parts`` marker's count, or None when absent/unreadable."""
+    try:
+        with open(os.path.join(directory, _PARTS_FILE)) as f:
+            on_disk = int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+    return on_disk if on_disk >= 1 else None
+
+
+def resolve_partitions(directory: str, requested: int = 1) -> int:
+    """The partition count a log at ``directory`` MUST be opened with.
+
+    A WAL's partition count is fixed at birth: the entity->partition hash
+    only recovers per-entity ordering if every record an entity ever
+    wrote lives in one partition, so re-routing a live log would strand
+    (or worse, reorder) records. On-disk evidence therefore wins over the
+    requested count, with a warning on mismatch so the operator knows the
+    flag was ignored rather than silently honored:
+
+    1. a ``wal.parts`` marker pins the count it records;
+    2. else a flat single-partition log at the root pins 1 (move the old
+       log aside to re-partition);
+    3. else (empty/new directory) the requested count stands.
+    """
+    if requested < 1:
+        raise ValueError(f"wal partitions must be >= 1, got {requested}")
+    on_disk = _marker_partitions(directory)
+    if on_disk is not None:
+        if on_disk != requested:
+            logger.warning(
+                "wal %s is partitioned P=%d on disk; ignoring requested "
+                "P=%d (partition count is fixed at log creation)",
+                directory, on_disk, requested,
+            )
+        return on_disk
+    if _flat_log_exists(directory):
+        if requested > 1:
+            logger.warning(
+                "wal %s holds an existing single-partition log; ignoring "
+                "requested P=%d (move the old log aside to re-partition)",
+                directory, requested,
+            )
+        return 1
+    return requested
+
+
+def partition_count(directory: str) -> int:
+    """Partition count of the log at ``directory``, read straight off disk
+    (1 when unmarked -- the flat layout). Cross-process safe: followers
+    call this to discover how many tails to run. A pure read: unlike
+    :func:`resolve_partitions` it never warns, because there is no
+    requested count to mismatch."""
+    return _marker_partitions(directory) or 1
+
+
+def partition_dirs(directory: str, partitions: int | None = None) -> list[str]:
+    """The per-partition log directories, in partition order. For the flat
+    P=1 layout this is ``[directory]`` itself -- every consumer that maps
+    over partitions handles old logs with zero special-casing."""
+    n = partition_count(directory) if partitions is None else partitions
+    if n <= 1:
+        return [directory]
+    return [os.path.join(directory, _part_dir_name(k)) for k in range(n)]
 
 
 class WriteAheadLog:
@@ -376,3 +479,89 @@ class WriteAheadLog:
                     os.fsync(self._file.fileno())
                 self._file.close()
                 self._file = None
+
+
+class PartitionedWal:
+    """P independent :class:`WriteAheadLog` streams under one root.
+
+    Each partition is a COMPLETE log -- own seqno space, own segments,
+    own checkpoint, own group-commit fsync stream -- so P writer threads
+    fsync in parallel with zero shared write state, and replay/durability
+    invariants (R003: fsync before cursor) hold per partition with no
+    cross-partition protocol at all. Routing (which entity goes to which
+    partition) is the caller's job via ``utils.stablehash``; this class
+    only owns the layout.
+
+    P = 1 opens one inner log rooted at ``directory`` itself: the on-disk
+    bytes are identical to a plain :class:`WriteAheadLog`, old flat logs
+    replay unchanged, and no marker file is written. P > 1 stamps
+    ``wal.parts`` FIRST (fsync'd: the marker is the layout's source of
+    truth for every later open and for cross-process followers -- a crash
+    between subdir creation and an unmarked marker must not make the same
+    directory resolve to P=1 on restart).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        partitions: int = 1,
+        segment_bytes: int = 64 << 20,
+        fsync_policy: str = "always",
+        fsync_interval_ms: float = 100.0,
+    ):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.partitions = resolve_partitions(directory, partitions)
+        if self.partitions > 1:
+            self._write_marker(self.partitions)
+        self.parts: list[WriteAheadLog] = [
+            WriteAheadLog(
+                part_dir,
+                segment_bytes=segment_bytes,
+                fsync_policy=fsync_policy,
+                fsync_interval_ms=fsync_interval_ms,
+            )
+            for part_dir in partition_dirs(directory, self.partitions)
+        ]
+
+    def _write_marker(self, partitions: int) -> None:
+        path = os.path.join(self.directory, _PARTS_FILE)
+        try:
+            with open(path) as f:
+                if int(f.read().strip()) == partitions:
+                    return
+        except (OSError, ValueError):
+            pass
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(partitions))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def part(self, index: int) -> WriteAheadLog:
+        return self.parts[index]
+
+    def part_dirs(self) -> list[str]:
+        return partition_dirs(self.directory, self.partitions)
+
+    # -- aggregate observability (mirrors WriteAheadLog's counters so the
+    # -- event server's scrape hook works against either) -------------------
+    @property
+    def append_count(self) -> int:
+        return sum(p.append_count for p in self.parts)
+
+    @property
+    def fsync_count(self) -> int:
+        return sum(p.fsync_count for p in self.parts)
+
+    @property
+    def last_fsync_s(self) -> float:
+        return max((p.last_fsync_s for p in self.parts), default=0.0)
+
+    def pending(self) -> int:
+        return sum(p.pending() for p in self.parts)
+
+    def close(self) -> None:
+        for p in self.parts:
+            p.close()

@@ -172,6 +172,12 @@ def compute_stats(prev: dict, cur: dict) -> dict:
             max(v - pfw_req.get(k, 0.0), 0.0) for k, v in fw_req.items()
         )
         stats["frontend_qps"] = round(d_fw / dt, 1)
+    parts = cm.get("pio_ingest_partitions")
+    if parts:
+        # the partitioned ingest tier: WAL partition count in the PART
+        # column (per-partition queue depth and commit latency live in
+        # pio_ingest_partition_depth{part=} / pio_ingest_commit_seconds{part=})
+        stats["wal_partitions"] = int(max(parts.values()))
     shards = cm.get("pio_scorer_shard_count")
     if shards:
         # the sharded serving fabric: scorer shard count in the SHARD
@@ -216,7 +222,7 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
         "",
         f"{'SERVICE':<32}{'QPS':>8}{'P50MS':>9}{'P99MS':>9}"
         f"{'ERR%':>7}{'QUEUE':>7}{'BATCH':>7}{'WKR':>5}{'SHARD':>6}"
-        f"{'WAKE':>6}{'MODEL':>7}{'SWAP':>8}{'LAG':>7}",
+        f"{'PART':>6}{'WAKE':>6}{'MODEL':>7}{'SWAP':>8}{'LAG':>7}",
     ]
     for s in stats_list:
         if s.get("error"):
@@ -232,6 +238,7 @@ def render(stats_list: list[dict], snapshots: list[dict], width: int = 100) -> s
             f"{_fmt(s.get('batch_occupancy')):>7}"
             f"{_fmt(s.get('frontend_workers')):>5}"
             f"{_fmt(s.get('scorer_shards')):>6}"
+            f"{_fmt(s.get('wal_partitions')):>6}"
             f"{_fmt(s.get('wakeups_per_request')):>6}"
             f"{_fmt(s.get('model_version')):>7}"
             f"{_fmt(s.get('swap_age_s'), 's'):>8}"

@@ -19,6 +19,14 @@ Segment GC can outrun a follower that was down for a long time (the WAL
 only retains segments past ITS checkpoint). That is reported as a ``gap``:
 the loop then resynchronizes by refreshing the snapshot to "now" -- the
 events are all in the store, only the cheap change detection was lost.
+
+Against a PARTITIONED WAL (``data/wal.PartitionedWal``) the retrain loop
+runs one tail + one durable cursor per partition (:func:`partition_tails`
+discovers the layout off disk). Every invariant above -- storage-bounded
+upper end, advance-after-swap, R003's fsync-before-rename cursor write --
+holds independently in each partition; :func:`merge_batches` unions the
+per-partition deltas (touched rows, vocab, event-time bounds) into the
+single fold-in the loop publishes.
 """
 
 from __future__ import annotations
@@ -193,3 +201,50 @@ class WalTail:
             if batch.max_event_ms is None or ms > batch.max_event_ms:
                 batch.max_event_ms = ms
         return batch
+
+
+def partition_tails(
+    directory: str,
+    app_id: int,
+    channel_id: int | None = None,
+    event_names: list[str] | None = None,
+) -> list[WalTail]:
+    """One :class:`WalTail` per WAL partition, in partition order. The
+    layout is read off disk (``data/wal.partition_count``), NOT configured:
+    the follower runs in a different process than the ingest writer, and
+    trusting a flag over the marker file would tail directories the writer
+    never fills. A flat P=1 log yields a single tail on the root."""
+    return [
+        WalTail(part_dir, app_id, channel_id, event_names)
+        for part_dir in wal_mod.partition_dirs(directory)
+    ]
+
+
+def merge_batches(batches: list[TailBatch]) -> TailBatch:
+    """Union per-partition poll results into the ONE delta the loop folds:
+    touched users/items/set-types union, record counts sum, event-time
+    window spans the widest bounds, and any partition's GC gap poisons the
+    merge (lost records may touch anything). ``last_seqno`` is the max
+    across INDEPENDENT per-partition seqno spaces -- diagnostic only
+    (registry metadata); cursor advancement is always per-partition."""
+    merged = TailBatch()
+    for b in batches:
+        merged.last_seqno = max(merged.last_seqno, b.last_seqno)
+        merged.records += b.records
+        merged.set_records += b.set_records
+        merged.touched_users |= b.touched_users
+        merged.touched_items |= b.touched_items
+        merged.touched_set_types |= b.touched_set_types
+        merged.gap = merged.gap or b.gap
+        for bound in ("min_event_ms", "max_event_ms"):
+            val = getattr(b, bound)
+            if val is None:
+                continue
+            cur = getattr(merged, bound)
+            if cur is None:
+                setattr(merged, bound, val)
+            elif bound == "min_event_ms":
+                setattr(merged, bound, min(cur, val))
+            else:
+                setattr(merged, bound, max(cur, val))
+    return merged

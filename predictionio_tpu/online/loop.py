@@ -24,6 +24,15 @@ One iteration (:meth:`RetrainLoop.run_once`):
    A crash (SIGKILL included) at any earlier point replays the same
    window next run; fold-in's full-history re-solve makes that replay
    converge instead of double-applying.
+
+Against a partitioned WAL (``--wal-partitions P``) step 1 becomes P
+concurrent tail polls with one durable cursor each; their deltas merge
+(touched-row/vocab union, window = min across partitions) into the ONE
+refresh + fold-in + publish of steps 2-4, and step 5 advances each
+participating cursor independently. A partition whose poll fails -- or
+whose records are all future-dated -- is excluded from the merge alone:
+its cursor holds and its window replays on recovery, while the siblings
+keep publishing.
 """
 
 from __future__ import annotations
@@ -41,7 +50,11 @@ from predictionio_tpu.online.foldin import (
     StalenessBudget,
     StalenessExceeded,
 )
-from predictionio_tpu.online.follower import TailCursor, WalTail
+from predictionio_tpu.online.follower import (
+    TailCursor,
+    merge_batches,
+    partition_tails,
+)
 from predictionio_tpu.online.registry import ModelRegistry
 
 logger = logging.getLogger("pio.online.loop")
@@ -141,12 +154,17 @@ class RetrainLoop:
             from predictionio_tpu.data.storage import base_dir
 
             wal_dir = os.path.join(base_dir(), "wal")
-        self.tail = WalTail(
+        # one tail per WAL partition, discovered off disk: a partitioned
+        # ingest tier (--wal-partitions P) gets P independent change
+        # detectors whose deltas merge before the single publish below
+        self.tails = partition_tails(
             wal_dir,
             self.handle.app_id,
             self.handle.channel_id,
             self.handle.event_names,
         )
+        self.partitions = len(self.tails)
+        self.tail = self.tails[0]  # the P=1 alias tests and tools use
         mode, root = snapshot_settings(self.instance.runtime_conf)
         del mode  # the loop's backbone IS the snapshot; always refresh
         self.snapshots = SnapshotStore(
@@ -162,39 +180,105 @@ class RetrainLoop:
                 rating_key=self.handle.rating_key,
             ),
         )
-        self.cursor = TailCursor(os.path.join(self.registry.dir, "follow", "cursor.json"))
-        if self.cursor.until_ms == 0:
-            # fresh cursor: the deployed base model reflects events up to
-            # (at least) its training scan's start; fold-in windows that
-            # overlap it are harmless (full-history re-solve)
-            self.cursor.until_ms = base_until_ms
+        follow_dir = os.path.join(self.registry.dir, "follow")
+        if self.partitions == 1:
+            # the pre-partitioning path, byte-compatible: existing
+            # followers resume from their old cursor file unchanged
+            self.cursors = [TailCursor(os.path.join(follow_dir, "cursor.json"))]
+        else:
+            self.cursors = [
+                TailCursor(os.path.join(follow_dir, f"cursor-p{k:05d}.json"))
+                for k in range(self.partitions)
+            ]
+        self.cursor = self.cursors[0]  # the P=1 alias tests assert on
+        for cursor in self.cursors:
+            if cursor.until_ms == 0:
+                # fresh cursor: the deployed base model reflects events up
+                # to (at least) its training scan's start; fold-in windows
+                # that overlap it are harmless (full-history re-solve)
+                cursor.until_ms = base_until_ms
         self.last_lag_s = 0.0
         self.cycles = {"idle": 0, "foldin": 0, "full_retrain": 0,
                        "noop": 0, "swap_failed": 0}
 
     # -- one cycle -----------------------------------------------------------
+    def _poll_partitions(self) -> list:
+        """Poll every partition's tail; returns ``(part, cursor, batch)``
+        triples where ``batch`` is None for a partition whose poll FAILED
+        (I/O error, injected fault). Failure is isolated by design: a dead
+        partition's cursor holds (its window replays once it recovers)
+        while the siblings' deltas still merge and publish -- freshness
+        degrades by one partition, not to zero. P > 1 polls concurrently:
+        the scans are independent directory reads, and serializing them
+        would re-serialize exactly the tail latency partitioning split."""
+
+        def poll_one(k: int):
+            self._test_fail_part(k)
+            return self.tails[k].poll(self.cursors[k].seqno)
+
+        results: list = [None] * self.partitions
+        if self.partitions == 1:
+            try:
+                results[0] = poll_one(0)
+            except Exception:
+                logger.exception("WAL tail poll failed")
+        else:
+            def run(k: int) -> None:
+                try:
+                    results[k] = poll_one(k)
+                except Exception:
+                    logger.exception(
+                        "partition %d tail poll failed; excluding its"
+                        " window from this cycle (cursor holds, replays"
+                        " on recovery)", k,
+                    )
+
+            pollers = [
+                threading.Thread(target=run, args=(k,), daemon=True)
+                for k in range(self.partitions)
+            ]
+            for t in pollers:
+                t.start()
+            for t in pollers:
+                t.join()
+        return [
+            (k, self.cursors[k], results[k]) for k in range(self.partitions)
+        ]
+
     def run_once(self) -> str:
         import datetime as _dt
 
         from predictionio_tpu.data import storage
         from predictionio_tpu.utils.metrics import global_registry
 
-        batch = self.tail.poll(self.cursor.seqno)
-        if batch.empty:
-            if batch.last_seqno > self.cursor.seqno:
+        polls = self._poll_partitions()
+        live = [(k, c, b) for k, c, b in polls if b is not None]
+        if len(live) < self.partitions:
+            self._count_part_failures(self.partitions - len(live))
+        if not live:
+            self._count("error")
+            return "error"
+        registry = global_registry()
+        now = time.time()
+        for k, c, b in live:
+            if b.empty and b.last_seqno > c.seqno:
                 # records were examined but none matched the followed scan
                 # (another app/channel/event type): skip past them so a
                 # busy multi-tenant WAL is not rescanned every poll. The
                 # reflected-model bound (until_ms/rows) is untouched.
-                self.cursor.advance(
-                    batch.last_seqno, self.cursor.until_ms,
-                    self.cursor.snapshot_rows,
-                )
+                c.advance(b.last_seqno, c.until_ms, c.snapshot_rows)
+            registry.set_gauge(
+                "pio_foldin_partition_lag_seconds", b.lag_seconds(now),
+                labels={"part": str(k)},
+                help="Age of the oldest unreflected event per WAL partition",
+            )
+        work = [(k, c, b) for k, c, b in live if not b.empty]
+        if not work:
             self.last_lag_s = 0.0
             self._push_lag(0.0)
             self._count("idle")
             return "idle"
-        self.last_lag_s = batch.lag_seconds()
+        self.last_lag_s = max(b.lag_seconds(now) for _, _, b in work)
         global_registry().set_gauge(
             "pio_foldin_lag_seconds", self.last_lag_s,
             help="Age of the oldest ingested event not yet reflected in a"
@@ -204,12 +288,22 @@ class RetrainLoop:
         le = storage.get_l_events()
         until = _dt.datetime.now(_dt.timezone.utc)
         now_ms = int(until.timestamp() * 1000)
-        if batch.min_event_ms is not None and batch.min_event_ms >= now_ms:
-            # every pending record is future-dated (client clock skew):
-            # the refresh bound (now) cannot cover any of them yet. Keep
-            # the cursor and retry next poll, once their time has passed.
+        # a partition whose EVERY pending record is future-dated (client
+        # clock skew) defers alone -- the refresh bound (now) cannot cover
+        # its window yet, so its cursor holds and it replays next poll --
+        # while ready siblings still fold and publish
+        ready = [
+            (k, c, b) for k, c, b in work
+            if not (b.min_event_ms is not None and b.min_event_ms >= now_ms)
+        ]
+        if not ready:
             self._count("deferred")
             return "deferred"
+        # live-but-empty partitions ride the advance below: the published
+        # model reflects the shared snapshot bound, and an empty window
+        # advancing until_ms keeps future fold windows tight
+        idle_live = [(k, c, b) for k, c, b in live if b.empty]
+        merged = merge_batches([b for _, _, b in ready])
         snap = self.snapshots.ensure(le, "refresh", until_time=until)
         if snap is None:
             logger.error(
@@ -218,21 +312,28 @@ class RetrainLoop:
             )
             self._count("noop")
             return "unsupported"
-        if batch.gap:
+        if merged.gap:
             # seqnos were GC'd before this follower saw them: the delta is
             # UNKNOWN (lost records may touch any user, with any event
             # time), so a fold-in cannot promise coverage -- rebaseline
             logger.warning(
-                "WAL GC gap behind cursor %d (oldest retained record is"
-                " newer); escalating to a full retrain", self.cursor.seqno,
+                "WAL GC gap behind cursor(s) %s (oldest retained record is"
+                " newer); escalating to a full retrain",
+                [c.seqno for _, c, _ in ready],
             )
             return self._full_retrain(
-                batch, snap, "WAL GC gap: records collected unseen"
+                ready + idle_live, merged, snap,
+                "WAL GC gap: records collected unseen",
             )
-        window_start_ms = self.cursor.until_ms
-        if batch.min_event_ms is not None:
-            # client-supplied event times may predate the cursor bound
-            window_start_ms = min(window_start_ms, batch.min_event_ms)
+        # window = min across participating partitions: the fold must cover
+        # the oldest unreflected event anywhere, and client-supplied event
+        # times may predate a partition's cursor bound
+        window_start_ms = min(
+            c.until_ms if b.min_event_ms is None
+            else min(c.until_ms, b.min_event_ms)
+            for _, c, b in ready
+        )
+        batch = merged
         delta = FoldinDelta(
             snapshot=snap,
             window_start_ms=window_start_ms,
@@ -263,10 +364,10 @@ class RetrainLoop:
                     any_change = True
                     new_models.append(folded)
         except StalenessExceeded as exc:
-            return self._full_retrain(batch, snap, str(exc))
+            return self._full_retrain(ready + idle_live, merged, snap, str(exc))
         if not any_change:
             # e.g. the window's records carried no scorable interaction
-            self._maybe_advance(batch, snap)
+            self._maybe_advance(ready + idle_live, snap)
             self._count("noop")
             return "noop"
 
@@ -287,16 +388,17 @@ class RetrainLoop:
             return "swap_failed"  # cursor stays; next cycle re-folds
         self.models = new_models
         self.current_version = version.version
-        self._maybe_advance(batch, snap)
+        self._maybe_advance(ready + idle_live, snap)
         self._count("foldin")
         logger.info(
-            "fold-in v%d: %d record(s), %d touched user(s), lag %.2fs",
+            "fold-in v%d: %d record(s), %d touched user(s), %d partition(s),"
+            " lag %.2fs",
             version.version, batch.records, len(batch.touched_users),
-            self.last_lag_s,
+            len(ready), self.last_lag_s,
         )
         return "foldin"
 
-    def _full_retrain(self, batch, snap, reason: str) -> str:
+    def _full_retrain(self, parts, batch, snap, reason: str) -> str:
         from predictionio_tpu.data import storage
         from predictionio_tpu.workflow.core_workflow import (
             engine_params_from_instance,
@@ -342,7 +444,7 @@ class RetrainLoop:
             self._count("swap_failed")
             return "swap_failed"
         self.current_version = version.version
-        self._advance(batch, snap)
+        self._advance(parts, snap)
         self._count("full_retrain")
         return "full_retrain"
 
@@ -432,40 +534,68 @@ class RetrainLoop:
             )
         return blobs
 
-    def _advance(self, batch, snap) -> None:
-        self.cursor.advance(
-            batch.last_seqno, int(snap.manifest["until_ms"]), len(snap)
-        )
+    def _advance(self, parts, snap) -> None:
+        """Advance every participating partition's cursor -- each to ITS
+        OWN last examined seqno (the seqno spaces are independent), all to
+        the shared snapshot bound the published model reflects. R003's
+        fsync-before-rename protocol runs inside each ``advance``, so a
+        crash mid-loop leaves a PREFIX of partitions advanced: the rest
+        replay their window, which fold-in absorbs."""
+        until_ms = int(snap.manifest["until_ms"])
+        rows = len(snap)
+        for _, cursor, batch in parts:
+            cursor.advance(batch.last_seqno, until_ms, rows)
 
     #: clock-skew horizon: a batch containing a record dated further ahead
     #: than this still advances (with a warning) instead of replaying every
     #: poll until the far-future time passes
     MAX_DEFER_SKEW_MS = 300_000
 
-    def _maybe_advance(self, batch, snap) -> None:
-        """Advance the cursor -- unless the batch contains a record whose
-        event time the refresh bound could not cover yet (future-dated via
-        client clock skew, within ``MAX_DEFER_SKEW_MS``). Deferring keeps
-        the record in the tail window so the next poll replays it once its
-        time has passed; replay is free because fold-in re-solves from
-        full history."""
+    def _maybe_advance(self, parts, snap) -> None:
+        """Advance each participating cursor -- except a partition whose
+        batch contains a record the refresh bound could not cover yet
+        (future-dated via client clock skew, within ``MAX_DEFER_SKEW_MS``).
+        The defer is PER PARTITION: one skewed client holds only its own
+        partition's cursor (that window replays next poll), never its
+        siblings'. Replay is free because fold-in re-solves from full
+        history."""
         until_ms = int(snap.manifest["until_ms"])
-        if batch.max_event_ms is not None and batch.max_event_ms >= until_ms:
-            skew = batch.max_event_ms - until_ms
-            if skew < self.MAX_DEFER_SKEW_MS:
-                logger.info(
-                    "deferring cursor: a record is dated %.1fs ahead of the"
-                    " refresh bound (client clock skew); will replay",
-                    skew / 1000.0,
+        rows = len(snap)
+        for part, cursor, batch in parts:
+            if batch.max_event_ms is not None and batch.max_event_ms >= until_ms:
+                skew = batch.max_event_ms - until_ms
+                if skew < self.MAX_DEFER_SKEW_MS:
+                    logger.info(
+                        "deferring partition %d cursor: a record is dated"
+                        " %.1fs ahead of the refresh bound (client clock"
+                        " skew); will replay", part, skew / 1000.0,
+                    )
+                    continue
+                logger.warning(
+                    "partition %d record dated %.1fs in the future (beyond"
+                    " the %.0fs defer horizon): advancing past it; it folds"
+                    " at the next cycle after its event time passes",
+                    part, skew / 1000.0, self.MAX_DEFER_SKEW_MS / 1000.0,
                 )
-                return
-            logger.warning(
-                "record dated %.1fs in the future (beyond the %.0fs defer"
-                " horizon): advancing past it; it folds at the next cycle"
-                " after its event time passes", skew / 1000.0,
-                self.MAX_DEFER_SKEW_MS / 1000.0,
-            )
-        self._advance(batch, snap)
+            cursor.advance(batch.last_seqno, until_ms, rows)
+
+    def _count_part_failures(self, n: int) -> None:
+        from predictionio_tpu.utils.metrics import global_registry
+
+        self.cycles["part_failures"] = self.cycles.get("part_failures", 0) + n
+        global_registry().inc(
+            "pio_foldin_partition_failures_total", amount=float(n),
+            help="Partition tail polls that failed and were excluded from"
+            " a merge cycle",
+        )
+
+    def _test_fail_part(self, part: int) -> None:
+        """Failure-injection hook for the partition-isolation chaos tests:
+        kill ONE partition's poll on demand. Inert in production -- the
+        env var is unset."""
+        target = os.environ.get("PIO_ONLINE_TEST_FAIL_PART", "")
+        if target != "" and int(target) == part:
+            raise RuntimeError(f"injected partition {part} poll failure")
 
     def _count(self, result: str) -> None:
         from predictionio_tpu.utils.metrics import global_registry

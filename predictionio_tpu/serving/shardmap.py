@@ -9,27 +9,25 @@ routes a query and the process that holds the factors.
 Import-light on purpose: the frontend worker (serving/frontend.py) is a
 no-jax, no-numpy interpreter, so only stdlib may be imported here.
 
-``zlib.crc32`` rather than ``hash()``: Python string hashing is salted
-per interpreter (PYTHONHASHSEED), and the router and the shards are
-*different* interpreters -- a salted hash would route user u to shard 1
-while shard 2 holds u's factors. CRC32 is stable across processes,
-platforms, and releases, which also makes the registry's per-shard
-blobs portable between a publisher and any later deploy.
+The hash itself lives in ``utils/stablehash`` -- the ingest pipeline's
+WAL-partition router buckets entities with the SAME function, so the
+partition an event is durably ordered in always matches the shard that
+serves the entity. See that module for the crc32-over-``hash()``
+rationale (per-interpreter hash salting).
 """
 
 from __future__ import annotations
 
 import json
-import zlib
+
+from predictionio_tpu.utils.stablehash import stable_bucket
 
 __all__ = ["shard_of", "extract_user"]
 
 
 def shard_of(user_id: str, num_shards: int) -> int:
     """The shard that owns ``user_id``'s factor rows (0-based)."""
-    if num_shards <= 1:
-        return 0
-    return zlib.crc32(str(user_id).encode("utf-8")) % num_shards
+    return stable_bucket(user_id, num_shards)
 
 
 def extract_user(body: bytes) -> str | None:

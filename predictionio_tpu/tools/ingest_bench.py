@@ -18,6 +18,14 @@ kill-and-replay durability cycle:
   the parent replays the WAL tail and asserts zero lost / zero duplicated
   acknowledged events (run twice to prove replay idempotence).
 
+``--wal-partitions`` takes either one value (the WAL phase and crash
+cycle run at that partition count) or a comma list (``1,2,4,8``), which
+switches to a sweep: the same group-commit load is re-driven at each
+partition count and the report shows eps per P plus scaling vs P=1. The
+partitioned crash cycle additionally audits that every surviving WAL
+frame lives in the partition its entity hashes to (zero cross-partition
+routing drift) and that each partition's second replay is a no-op.
+
 Load is driven at the ``EventService`` layer (``_insert_one``), not over
 HTTP: this box's HTTP envelope saturates around a few hundred req/s and
 would mask the storage-commit effect under test (``serving_bench`` owns
@@ -125,6 +133,7 @@ def run_ab(
     fsync_policy: str = "always",
     crash_events: int = 200,
     workdir: str | None = None,
+    wal_partitions: int = 1,
 ) -> dict:
     from predictionio_tpu.data.api.eventserver import EventService
     from predictionio_tpu.data.ingest import IngestConfig
@@ -134,6 +143,7 @@ def run_ab(
         "events_per_client": events_per_client,
         "group_commit_ms": group_commit_ms,
         "fsync_policy": fsync_policy,
+        "wal_partitions": wal_partitions,
     }
     own_tmp = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="pio_ingest_bench_")
@@ -162,6 +172,7 @@ def run_ab(
                 mode="wal",
                 group_commit_ms=group_commit_ms,
                 fsync_policy=fsync_policy,
+                wal_partitions=wal_partitions,
             )
         )
         try:
@@ -184,7 +195,74 @@ def run_ab(
     # -- C: kill-and-replay durability cycle ----------------------------------
     if crash_events:
         report["crash_cycle"] = run_crash_cycle(
-            os.path.join(workdir, "crash"), min_acked=crash_events
+            os.path.join(workdir, "crash"),
+            min_acked=crash_events,
+            partitions=wal_partitions,
+        )
+    if own_tmp:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return report
+
+
+def run_sweep(
+    partitions: tuple[int, ...] = (1, 2, 4, 8),
+    clients: int = 32,
+    events_per_client: int = 50,
+    group_commit_ms: float = 5.0,
+    fsync_policy: str = "always",
+    crash_partitions: int | None = None,
+    crash_events: int = 200,
+    workdir: str | None = None,
+) -> dict:
+    """Drive the SAME group-commit load at each partition count and report
+    eps per P. Only the WAL arm runs (the sync baselines don't change with
+    P); ``crash_partitions`` optionally tacks on one kill-and-replay cycle
+    at that partition count."""
+    from predictionio_tpu.data.api.eventserver import EventService
+    from predictionio_tpu.data.ingest import IngestConfig
+
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="pio_ingest_sweep_")
+    report: dict = {
+        "clients": clients,
+        "events_per_client": events_per_client,
+        "group_commit_ms": group_commit_ms,
+        "fsync_policy": fsync_policy,
+        "partitions": {},
+    }
+    for p in partitions:
+        with _Env(os.path.join(workdir, f"p{p}")):
+            storage_registry.get_l_events().init_channel(APP_ID)
+            service = EventService(
+                ingest_config=IngestConfig(
+                    mode="wal",
+                    group_commit_ms=group_commit_ms,
+                    fsync_policy=fsync_policy,
+                    wal_partitions=p,
+                )
+            )
+            try:
+                arm = _drive(service, clients, events_per_client)
+            finally:
+                service.shutdown_ingest()
+            arm["stored"] = _stored_count()
+            report["partitions"][str(p)] = arm
+    base = report["partitions"][str(partitions[0])]["eps"]
+    for p in partitions:
+        arm = report["partitions"][str(p)]
+        arm["scaling_vs_first"] = round(arm["eps"] / base, 2) if base else None
+    eps_seq = [report["partitions"][str(p)]["eps"] for p in sorted(partitions)]
+    # 10% jitter allowance: two cores + sqlite make exact monotonicity noisy
+    report["monotonic"] = all(
+        b >= a * 0.9 for a, b in zip(eps_seq, eps_seq[1:])
+    )
+    if crash_partitions:
+        report["crash_cycle"] = run_crash_cycle(
+            os.path.join(workdir, "crash"),
+            min_acked=crash_events,
+            partitions=crash_partitions,
         )
     if own_tmp:
         import shutil
@@ -195,11 +273,11 @@ def run_ab(
 
 # -- crash cycle --------------------------------------------------------------
 
-def _crash_child(workdir: str) -> None:
+def _crash_child(workdir: str, partitions: int = 1) -> None:
     """Ingest forever through the pipeline (fsync=always), logging each
     acknowledged eventId; the parent SIGKILLs us mid-stream."""
-    from predictionio_tpu.data.ingest import IngestPipeline
-    from predictionio_tpu.data.wal import WriteAheadLog
+    from predictionio_tpu.data.ingest import PartitionedIngestPipeline
+    from predictionio_tpu.data.wal import PartitionedWal
     from predictionio_tpu.data.event import Event
 
     os.environ["PIO_FS_BASEDIR"] = workdir
@@ -217,30 +295,44 @@ def _crash_child(workdir: str) -> None:
             time.sleep(0.02)
             return real.insert_batch(items, on_duplicate=on_duplicate)
 
-    wal = WriteAheadLog(os.path.join(workdir, "wal"), fsync_policy="always")
-    pipeline = IngestPipeline(
+    wal = PartitionedWal(
+        os.path.join(workdir, "wal"),
+        partitions=partitions,
+        fsync_policy="always",
+    )
+    pipeline = PartitionedIngestPipeline(
         wal, l_events=lambda: _SlowEvents(), group_commit_ms=2.0
     ).start()
+    # spread entities so every partition takes writes (P=1 keeps the
+    # original single-entity stream)
+    entity_span = 1 if partitions <= 1 else 4 * partitions
     acked = open(os.path.join(workdir, "acked.txt"), "w", buffering=1)
     i = 0
     while True:  # until SIGKILL
         futs = []
         for _ in range(16):
-            ev = Event.from_json_obj(_event_obj(0, i))
+            ev = Event.from_json_obj(_event_obj(i % entity_span, i))
             futs.append(pipeline.submit(ev, APP_ID, None))
             i += 1
         for f in futs:
             acked.write(f.result(timeout=30) + "\n")
 
 
-def run_crash_cycle(workdir: str, min_acked: int = 200, timeout_s: float = 60.0) -> dict:
-    """SIGKILL a pipeline mid-ingest, replay the WAL, prove exactly-once."""
+def run_crash_cycle(
+    workdir: str,
+    min_acked: int = 200,
+    timeout_s: float = 60.0,
+    partitions: int = 1,
+) -> dict:
+    """SIGKILL a pipeline mid-ingest, replay the WAL, prove exactly-once
+    (per partition when ``partitions`` > 1, with a routing audit on the
+    surviving frames)."""
     os.makedirs(workdir, exist_ok=True)
     env = dict(os.environ)
     env["PIO_FS_BASEDIR"] = workdir
     proc = subprocess.Popen(
         [sys.executable, "-m", "predictionio_tpu.tools.ingest_bench",
-         "--crash-child", workdir],
+         "--crash-child", workdir, "--crash-partitions", str(partitions)],
         env=env,
         stdout=subprocess.DEVNULL,
         stderr=subprocess.PIPE,
@@ -274,16 +366,36 @@ def run_crash_cycle(workdir: str, min_acked: int = 200, timeout_s: float = 60.0)
         data = f.read()
     acked_ids = [line for line in data.split("\n")[:-1] if line]
 
-    from predictionio_tpu.data.ingest import replay_wal_into_storage
-    from predictionio_tpu.data.wal import WriteAheadLog
+    from predictionio_tpu.data import wal as wal_mod
+    from predictionio_tpu.data.ingest import (
+        partition_of,
+        replay_wal_into_storage,
+        wal_parse,
+    )
+    from predictionio_tpu.data.wal import PartitionedWal
 
     with _Env(workdir):
         stored_before = _stored_count()
-        wal = WriteAheadLog(os.path.join(workdir, "wal"), fsync_policy="never")
-        replayed = replay_wal_into_storage(wal)
+        wal = PartitionedWal(
+            os.path.join(workdir, "wal"),
+            partitions=partitions,
+            fsync_policy="never",
+        )
+        per_part = [replay_wal_into_storage(p) for p in wal.parts]
+        replayed = sum(per_part)
         stored_after = _stored_count()
-        # second replay cycle (a second "restart") must change nothing
-        replayed_again = replay_wal_into_storage(wal)
+        # second replay cycle (a second "restart") must change nothing,
+        # independently in every partition
+        per_part_again = [replay_wal_into_storage(p) for p in wal.parts]
+        replayed_again = sum(per_part_again)
+        # routing audit: a frame in partition k must hash to k -- any
+        # miss means the router and the on-disk layout drifted apart
+        misrouted = 0
+        for k, part in enumerate(wal.parts):
+            for _seqno, payload in wal_mod.iter_log_records(part.directory):
+                event, _app, _chan, _trace = wal_parse(payload)
+                if partition_of(event, wal.partitions) != k:
+                    misrouted += 1
         wal.close()
         stored_ids = [
             e.event_id
@@ -292,17 +404,21 @@ def run_crash_cycle(workdir: str, min_acked: int = 200, timeout_s: float = 60.0)
     stored_set = set(stored_ids)
     lost = [i for i in acked_ids if i not in stored_set]
     return {
+        "partitions": partitions,
         "acked": len(acked_ids),
         "stored_before_replay": stored_before,
         "replayed": replayed,
+        "replayed_per_partition": per_part,
         "stored_after_replay": stored_after,
         "lost": len(lost),
         "duplicated": len(stored_ids) - len(stored_set),
+        "misrouted": misrouted,
         "second_replay_records": replayed_again,
         "second_replay_delta": len(stored_ids) - stored_after,
         "exactly_once": not lost
         and len(stored_ids) == len(stored_set)
-        and replayed_again == 0,
+        and replayed_again == 0
+        and misrouted == 0,
     }
 
 
@@ -315,19 +431,37 @@ def main(argv: list[str] | None = None) -> int:
                         choices=("always", "interval", "never"))
     parser.add_argument("--crash-events", type=int, default=200,
                         help="min acked events before the kill (0 disables)")
+    parser.add_argument("--wal-partitions", default="1", metavar="P[,P...]",
+                        help="WAL partition count; a comma list (1,2,4,8)"
+                        " runs the partition sweep instead of the full A/B")
     parser.add_argument("--crash-child", metavar="DIR", default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--crash-partitions", type=int, default=1,
                         help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     if args.crash_child:
-        _crash_child(args.crash_child)
+        _crash_child(args.crash_child, partitions=args.crash_partitions)
         return 0
-    report = run_ab(
-        clients=args.clients,
-        events_per_client=args.events,
-        group_commit_ms=args.group_commit_ms,
-        fsync_policy=args.fsync_policy,
-        crash_events=args.crash_events,
-    )
+    part_list = [int(p) for p in str(args.wal_partitions).split(",") if p]
+    if len(part_list) > 1:
+        report = run_sweep(
+            partitions=tuple(part_list),
+            clients=args.clients,
+            events_per_client=args.events,
+            group_commit_ms=args.group_commit_ms,
+            fsync_policy=args.fsync_policy,
+            crash_partitions=max(part_list) if args.crash_events else None,
+            crash_events=args.crash_events,
+        )
+    else:
+        report = run_ab(
+            clients=args.clients,
+            events_per_client=args.events,
+            group_commit_ms=args.group_commit_ms,
+            fsync_policy=args.fsync_policy,
+            crash_events=args.crash_events,
+            wal_partitions=part_list[0] if part_list else 1,
+        )
     print(json.dumps(report, indent=2))
     return 0
 

@@ -123,9 +123,11 @@ def _timed_events(events: int, users: int, items: int) -> list:
 
 def _ingest_one(wal, le, user: str, item: str) -> float:
     """One durable ingest through the WAL pipeline's exact cycle; returns
-    the ack time (the freshness clock's zero)."""
+    the ack time (the freshness clock's zero). Against a
+    :class:`PartitionedWal` the event lands in the partition its entity
+    hashes to -- the event server's routing rule."""
     from predictionio_tpu.data import DataMap, Event
-    from predictionio_tpu.data.ingest import wal_payload
+    from predictionio_tpu.data.ingest import partition_of, wal_payload
 
     event = Event(
         event="rate",
@@ -135,11 +137,16 @@ def _ingest_one(wal, le, user: str, item: str) -> float:
         target_entity_id=item,
         properties=DataMap({"rating": 5.0}),
     ).with_id()
-    seqno = wal.append(wal_payload(event, APP_ID, None))
-    wal.sync()
+    target = (
+        wal.part(partition_of(event, wal.partitions))
+        if hasattr(wal, "parts")
+        else wal
+    )
+    seqno = target.append(wal_payload(event, APP_ID, None))
+    target.sync()
     t_ack = time.perf_counter()
     le.insert_batch([(event, APP_ID, None)], on_duplicate="ignore")
-    wal.checkpoint(seqno)
+    target.checkpoint(seqno)
     return t_ack
 
 
@@ -164,6 +171,7 @@ def _measure_arm(
     load_clients: int,
     freshness_timeout_s: float,
     interval_s: float,
+    ingest_load_clients: int = 0,
 ) -> dict:
     from predictionio_tpu.online.loop import RetrainConfig, RetrainLoop
 
@@ -195,9 +203,33 @@ def _measure_arm(
                 load_errors[0] += 1
             load_count[0] += 1
 
+    ingest_load_count = [0]
+    ingest_load_errors = [0]
+
+    def ingest_load_worker(k: int) -> None:
+        """Sustained background write pressure on KNOWN users: every event
+        rides the full durable cycle, so the follower must keep folding
+        this stream while the probes measure freshness."""
+        rng = np.random.default_rng(500 + k)
+        le = storage_registry.get_l_events()
+        while not stop.is_set():
+            try:
+                _ingest_one(
+                    wal, le,
+                    user=f"u{rng.integers(0, 20)}",
+                    item=f"i{rng.integers(0, 10)}",
+                )
+                ingest_load_count[0] += 1
+            except Exception:
+                ingest_load_errors[0] += 1
+            time.sleep(0.005)
+
     workers = [
         threading.Thread(target=load_worker, args=(k,), daemon=True)
         for k in range(load_clients)
+    ] + [
+        threading.Thread(target=ingest_load_worker, args=(k,), daemon=True)
+        for k in range(ingest_load_clients)
     ]
     for w in workers:
         w.start()
@@ -240,6 +272,8 @@ def _measure_arm(
         "freshness_s_max": round(max(latencies), 3) if latencies else None,
         "load_requests": load_count[0],
         "load_errors": load_errors[0],
+        "ingest_load_events": ingest_load_count[0],
+        "ingest_load_errors": ingest_load_errors[0],
         "cycles": dict(loop.cycles),
     }
 
@@ -256,9 +290,11 @@ def run_ab(
     interval_s: float = 0.2,
     workdir: str | None = None,
     full_retrain_arm: bool = True,
+    wal_partitions: int = 1,
+    ingest_load_clients: int = 0,
 ) -> dict:
     from predictionio_tpu.data.storage.base import App
-    from predictionio_tpu.data.wal import WriteAheadLog
+    from predictionio_tpu.data.wal import PartitionedWal
     from predictionio_tpu.online.foldin import StalenessBudget
     from predictionio_tpu.workflow.core_workflow import run_train
     from predictionio_tpu.workflow.create_server import create_query_server
@@ -266,6 +302,8 @@ def run_ab(
 
     report: dict = {
         "events": events, "users": users, "items": items, "rank": rank,
+        "wal_partitions": wal_partitions,
+        "ingest_load_clients": ingest_load_clients,
     }
     own_tmp = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="pio_retrain_bench_")
@@ -279,7 +317,8 @@ def run_ab(
         run_train(variant)
         report["train_seconds"] = round(time.perf_counter() - t0, 3)
 
-        wal = WriteAheadLog(os.path.join(workdir, "wal"))
+        wal = PartitionedWal(os.path.join(workdir, "wal"),
+                             partitions=wal_partitions)
         thread, service = create_query_server(variant, host="127.0.0.1", port=0)
         thread.start()
         url = f"http://127.0.0.1:{thread.port}"
@@ -290,12 +329,14 @@ def run_ab(
                     max_user_growth_frac=10.0,
                 ),
                 probes, load_clients, freshness_timeout_s, interval_s,
+                ingest_load_clients=ingest_load_clients,
             )
             if full_retrain_arm:
                 report["full_retrain"] = _measure_arm(
                     "full", url, variant, wal,
                     StalenessBudget(max_touched_frac=0.0),
                     probes, load_clients, freshness_timeout_s, interval_s,
+                    ingest_load_clients=ingest_load_clients,
                 )
                 a = report["foldin"].get("freshness_s_median")
                 b = report["full_retrain"].get("freshness_s_median")
@@ -431,6 +472,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--iterations", type=int, default=3)
     parser.add_argument("--probes", type=int, default=4)
     parser.add_argument("--load-clients", type=int, default=2)
+    parser.add_argument("--wal-partitions", type=int, default=1,
+                        help="ingest WAL partition count (the follower"
+                        " discovers the layout off disk)")
+    parser.add_argument("--ingest-load-clients", type=int, default=0,
+                        help="background durable-ingest writer threads"
+                        " running during each freshness arm")
     parser.add_argument("--no-full-retrain-arm", action="store_true")
     parser.add_argument(
         "--quality", action="store_true",
@@ -463,6 +510,8 @@ def main(argv: list[str] | None = None) -> int:
             probes=args.probes,
             load_clients=args.load_clients,
             full_retrain_arm=not args.no_full_retrain_arm,
+            wal_partitions=args.wal_partitions,
+            ingest_load_clients=args.ingest_load_clients,
         )
     print(json.dumps(report, indent=2))
     return 0

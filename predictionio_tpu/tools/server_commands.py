@@ -38,6 +38,18 @@ def register(sub: argparse._SubParsersAction) -> None:
         help="WAL directory (default $PIO_FS_BASEDIR/wal)",
     )
     es.add_argument(
+        "--wal-partitions", type=int, default=1, metavar="P",
+        help="hash-shard the WAL into P independent durability streams"
+        " (per-entity ordering preserved; fsyncs proceed in parallel)."
+        " Fixed at log creation: an existing log's on-disk count wins"
+        " (wal mode)",
+    )
+    es.add_argument(
+        "--frontend-workers", type=int, default=0, metavar="M",
+        help="spawn M SO_REUSEPORT frontend worker processes in front of"
+        " the ingest pipeline (0 = single-process listener, the default)",
+    )
+    es.add_argument(
         "--no-tracing", action="store_true",
         help="disable the span tracer (/traces.json reports enabled=false;"
         " the off path allocates no spans)",
@@ -107,10 +119,12 @@ def cmd_eventserver(args: argparse.Namespace) -> int:
             group_commit_ms=args.group_commit_ms,
             fsync_policy=args.fsync_policy,
             wal_dir=args.wal_dir,
+            wal_partitions=args.wal_partitions,
         ),
         tracing=False if args.no_tracing else None,
         trace_sample=args.trace_sample,
         slow_commit_ms=args.slow_commit_ms,
+        frontend_workers=args.frontend_workers,
     )
     return 0
 

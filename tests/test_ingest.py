@@ -1,6 +1,7 @@
 """Ingest pipeline tests: group commit, WAL-durable acks, backpressure,
 drain-on-shutdown, startup replay idempotence, the batch wire contract
-under the pipeline, and a SIGKILL crash-replay integration cycle."""
+under the pipeline, hash-partitioned routing and per-partition replay,
+and SIGKILL crash-replay integration cycles (flat and partitioned)."""
 
 import json
 import threading
@@ -9,6 +10,7 @@ import time
 import pytest
 import requests
 
+from predictionio_tpu.data import wal as wal_mod
 from predictionio_tpu.data.api.eventserver import (
     EventService,
     create_event_server,
@@ -18,11 +20,16 @@ from predictionio_tpu.data.ingest import (
     IngestConfig,
     IngestOverload,
     IngestPipeline,
+    PartitionedIngestPipeline,
+    partition_of,
+    replay_partitioned_wal,
     replay_wal_into_storage,
+    wal_parse,
 )
 from predictionio_tpu.data.storage.base import AccessKey, App
-from predictionio_tpu.data.wal import WriteAheadLog
+from predictionio_tpu.data.wal import PartitionedWal, WriteAheadLog
 from predictionio_tpu.utils.http import Request
+from predictionio_tpu.utils.stablehash import stable_bucket
 
 VALID = {"event": "rate", "entityType": "user", "entityId": "u1",
          "targetEntityType": "item", "targetEntityId": "i1",
@@ -206,6 +213,153 @@ class TestPipeline:
         # error: the append-only contract surfaces the caller bug
         with pytest.raises(Exception):
             l_events.insert_batch([(ev, 1, None)])
+
+
+# -- partitioned pipeline -----------------------------------------------------
+
+class TestPartitionedPipeline:
+    def test_routes_by_entity_hash_and_stores_all(self, storage_env, tmp_path):
+        """Every frame must land in the partition its entity hashes to --
+        the shardmap rule -- and the full stream must store exactly once."""
+        l_events = storage_env.get_l_events()
+        l_events.init_channel(1)
+        wal = PartitionedWal(str(tmp_path / "wal"), partitions=4)
+        pipe = PartitionedIngestPipeline(wal, group_commit_ms=5.0).start()
+        events = [_mk_event(i) for i in range(64)]
+        futures = [pipe.submit(ev, 1, None) for ev in events]
+        ids = [f.result(timeout=10) for f in futures]
+        pipe.stop()
+        assert len(set(ids)) == 64
+        stored = {e.event_id for e in l_events.find(app_id=1, limit=None)}
+        assert stored == set(ids)
+        seen_parts = set()
+        for k, part in enumerate(wal.parts):
+            for _seqno, payload in wal_mod.iter_log_records(part.directory):
+                ev, _app, _chan, _trace = wal_parse(payload)
+                assert stable_bucket(ev.entity_id, 4) == k
+                seen_parts.add(k)
+        assert seen_parts == {0, 1, 2, 3}  # 64 entities cover every partition
+        wal.close()
+
+    def test_same_entity_always_same_partition(self, storage_env, tmp_path):
+        """Per-entity ordering rides on routing stability: one entity, one
+        partition, one seqno line."""
+        l_events = storage_env.get_l_events()
+        l_events.init_channel(1)
+        wal = PartitionedWal(str(tmp_path / "wal"), partitions=4)
+        pipe = PartitionedIngestPipeline(wal, group_commit_ms=2.0).start()
+        futures = [pipe.submit(_mk_event(7), 1, None) for _ in range(12)]
+        for f in futures:
+            f.result(timeout=10)
+        pipe.stop()
+        home = partition_of(_mk_event(7), 4)
+        counts = [
+            sum(1 for _ in wal_mod.iter_log_records(p.directory))
+            for p in wal.parts
+        ]
+        assert counts[home] == 12
+        assert sum(counts) == 12
+        wal.close()
+
+    def test_p1_inner_pipeline_is_unlabeled(self, storage_env, tmp_path):
+        """P=1 must be observably identical to the pre-partitioning
+        pipeline: no part label, original writer-thread name."""
+        wal1 = PartitionedWal(str(tmp_path / "w1"), partitions=1)
+        pipe1 = PartitionedIngestPipeline(wal1)
+        assert pipe1.partitions == 1
+        assert pipe1.pipes[0].part is None
+        wal4 = PartitionedWal(str(tmp_path / "w4"), partitions=4)
+        pipe4 = PartitionedIngestPipeline(wal4)
+        assert [p.part for p in pipe4.pipes] == [0, 1, 2, 3]
+        wal1.close()
+        wal4.close()
+
+    def test_depth_of_and_aggregates(self, storage_env, tmp_path):
+        release = threading.Event()
+
+        class _Stalled:
+            def insert_batch(self, items, on_duplicate="error"):
+                release.wait(10)
+                return [ev.event_id for ev, _, _ in items]
+
+        wal = PartitionedWal(str(tmp_path / "wal"), partitions=2)
+        pipe = PartitionedIngestPipeline(
+            wal, l_events=lambda: _Stalled(), group_commit_ms=1.0
+        ).start()
+        try:
+            # park both writers, then queue one more per partition
+            first = [_mk_event(i) for i in range(8)]
+            for ev in first:
+                pipe.submit(ev, 1, None)
+            time.sleep(0.15)
+            queued = [_mk_event(i) for i in range(8, 16)]
+            for ev in queued:
+                pipe.submit(ev, 1, None)
+            assert pipe.depth() == sum(
+                pipe.depth_of(k) for k in range(pipe.partitions)
+            )
+        finally:
+            release.set()
+            pipe.stop()
+            wal.close()
+
+    def test_partitioned_replay_exactly_once(self, storage_env, tmp_path):
+        """Acked-but-unflushed events recover independently per partition;
+        a second restart replays nothing anywhere."""
+        l_events = storage_env.get_l_events()
+        l_events.init_channel(1)
+
+        class _Broken:
+            def insert_batch(self, items, on_duplicate="error"):
+                raise RuntimeError("storage down")
+
+        wal_dir = str(tmp_path / "wal")
+        wal = PartitionedWal(wal_dir, partitions=4)
+        pipe = PartitionedIngestPipeline(wal, l_events=lambda: _Broken()).start()
+        futures = [pipe.submit(_mk_event(i), 1, None) for i in range(24)]
+        ids = [f.result(timeout=10) for f in futures]  # acked: WAL-durable
+        pipe.stop()
+        wal.close()
+        assert sum(1 for _ in l_events.find(app_id=1, limit=None)) == 0
+
+        wal2 = PartitionedWal(wal_dir)  # layout adopted from the marker
+        assert wal2.partitions == 4
+        assert replay_partitioned_wal(wal2) == 24
+        stored = {e.event_id for e in l_events.find(app_id=1, limit=None)}
+        assert stored == set(ids)
+        assert replay_partitioned_wal(wal2) == 0
+        wal2.close()
+
+    def test_eventserver_exposes_partition_gauges(self, storage_env):
+        apps = storage_env.get_meta_data_apps()
+        app_id = apps.insert(App(name="PartApp"))
+        key = storage_env.get_meta_data_access_keys().insert(
+            AccessKey(key="", app_id=app_id)
+        )
+        storage_env.get_l_events().init_channel(app_id)
+        svc = create_event_server(
+            host="127.0.0.1",
+            port=0,
+            stats=True,
+            ingest_config=IngestConfig(
+                mode="wal", group_commit_ms=2.0, wal_partitions=3
+            ),
+        ).start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            r = requests.post(
+                f"{base}/events.json", params={"accessKey": key}, json=VALID
+            )
+            assert r.status_code == 201
+            text = requests.get(f"{base}/metrics").text
+        finally:
+            svc.stop()
+        assert "pio_ingest_partitions 3" in text
+        for k in range(3):
+            assert f'pio_ingest_partition_depth{{part="{k}"}}' in text
+        # commit-latency histogram carries the partition label once the
+        # routed partition has committed
+        assert 'pio_ingest_commit_seconds_count{part="' in text
 
 
 # -- event server in WAL mode -------------------------------------------------
@@ -402,6 +556,50 @@ def test_crash_replay_exactly_once(tmp_path):
     assert rep["second_replay_records"] == 0
     assert rep["second_replay_delta"] == 0
     assert rep["exactly_once"] is True
+
+
+def test_crash_replay_exactly_once_partitioned(tmp_path):
+    """Kill -9 the ingest process mid-group-commit at P=4: every
+    acknowledged event must recover exactly once IN ITS OWN partition --
+    per-partition replay counts, zero cross-partition duplication (the
+    routing audit), and an idempotent second restart in every partition."""
+    from predictionio_tpu.tools.ingest_bench import run_crash_cycle
+
+    rep = run_crash_cycle(
+        str(tmp_path / "crash"), min_acked=48, timeout_s=90.0, partitions=4
+    )
+    assert rep["partitions"] == 4
+    assert rep["acked"] >= 48
+    assert rep["lost"] == 0
+    assert rep["duplicated"] == 0
+    assert rep["misrouted"] == 0
+    assert len(rep["replayed_per_partition"]) == 4
+    assert rep["second_replay_records"] == 0
+    assert rep["second_replay_delta"] == 0
+    assert rep["exactly_once"] is True
+
+
+@pytest.mark.slow
+def test_ingest_partition_sweep(tmp_path):
+    """The --wal-partitions 1,2,4 sweep harness (bench.py's
+    ingest_partitioned_eps secondary): every arm stores the full load and
+    the report carries eps + scaling per partition count."""
+    from predictionio_tpu.tools.ingest_bench import run_sweep
+
+    rep = run_sweep(
+        partitions=(1, 2, 4),
+        clients=8,
+        events_per_client=10,
+        crash_partitions=None,
+        workdir=str(tmp_path / "sweep"),
+    )
+    for p in ("1", "2", "4"):
+        arm = rep["partitions"][p]
+        assert arm["stored"] == 8 * 10
+        assert arm["failures"] == 0
+        assert arm["eps"] > 0
+        assert arm["scaling_vs_first"] is not None
+    assert isinstance(rep["monotonic"], bool)
 
 
 @pytest.mark.slow

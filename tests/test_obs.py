@@ -1139,11 +1139,13 @@ pio_serving_batch_size_count %d
         frame = render([stats], [snap(102.0, 200, 150)])
         assert "WKR" in frame and "WAKE" in frame
         row = next(l for l in frame.splitlines() if "http://x:1" in l)
-        # WKR sits 6th from the end: SHARD (dash here -- not a fabric),
-        # WAKE (scorer wakeups/request) and the continuous-learning
-        # columns (MODEL/SWAP/LAG, dashes here) landed after it
-        assert row.split()[-6] == "2"
-        assert row.split()[-5] == "-"  # SHARD: unsharded service
+        # WKR sits 7th from the end: SHARD (dash here -- not a fabric),
+        # PART (dash -- unpartitioned ingest), WAKE (scorer
+        # wakeups/request) and the continuous-learning columns
+        # (MODEL/SWAP/LAG, dashes here) landed after it
+        assert row.split()[-7] == "2"
+        assert row.split()[-6] == "-"  # SHARD: unsharded service
+        assert row.split()[-5] == "-"  # PART: unpartitioned ingest
         assert row.split()[-4] == "2.0"  # the measured wakeup budget
 
     def test_shard_fabric_stats_and_render(self):
@@ -1181,8 +1183,44 @@ pio_serving_batch_size_count %d
         frame = render([stats], [snap(102.0)])
         assert "SHARD" in frame
         row = next(l for l in frame.splitlines() if "http://x:1" in l)
-        assert row.split()[-5] == "4"  # SHARD
+        assert row.split()[-6] == "4"  # SHARD
         assert row.split()[-3] == "7"  # MODEL
+
+    def test_ingest_partitions_stats_and_render(self):
+        """A partitioned event server's gauges reach the `pio top` view:
+        partition count in the PART column, queue depth still the summed
+        aggregate (the per-partition depth series is /metrics-only)."""
+        from predictionio_tpu.obs.top import (
+            compute_stats,
+            parse_prometheus,
+            render,
+        )
+
+        text = (
+            "pio_ingest_partitions 4\n"
+            "pio_ingest_queue_depth 6\n"
+            'pio_ingest_partition_depth{part="0"} 1\n'
+            'pio_ingest_partition_depth{part="1"} 0\n'
+            'pio_ingest_partition_depth{part="2"} 3\n'
+            'pio_ingest_partition_depth{part="3"} 2\n'
+        )
+
+        def snap(t):
+            return {
+                "url": "http://x:1",
+                "time": t,
+                "metrics": parse_prometheus(text),
+                "traces": None,
+            }
+
+        stats = compute_stats(snap(100.0), snap(102.0))
+        assert stats["wal_partitions"] == 4
+        assert stats["ingest_queue_depth"] == 6
+        frame = render([stats], [snap(102.0)])
+        assert "PART" in frame
+        row = next(l for l in frame.splitlines() if "http://x:1" in l)
+        assert row.split()[-5] == "4"  # PART
+        assert row.split()[-6] == "-"  # SHARD (not a scorer fabric)
 
     def test_parse_prometheus(self):
         from predictionio_tpu.obs.top import parse_prometheus

@@ -323,6 +323,67 @@ class TestTailFixture:
         assert only_set.lag_seconds() == 0.0
 
 
+class TestPartitionedFollower:
+    def test_partition_tails_discovers_layout_off_disk(self, tmp_path):
+        from predictionio_tpu.data.wal import PartitionedWal, partition_dirs
+        from predictionio_tpu.online.follower import partition_tails
+
+        d = str(tmp_path / "wal")
+        PartitionedWal(d, partitions=4).close()
+        tails = partition_tails(d, APP_ID, None, ["rate"])
+        assert [t.directory for t in tails] == partition_dirs(d)
+        assert len(tails) == 4
+        assert all(t.app_id == APP_ID for t in tails)
+        # a flat (P=1) log yields exactly one tail on the root -- and so
+        # does a directory that does not exist yet
+        flat = str(tmp_path / "flat")
+        assert [t.directory for t in partition_tails(flat, APP_ID)] == [flat]
+
+    def test_merge_batches_unions_deltas(self):
+        from predictionio_tpu.online.follower import TailBatch, merge_batches
+
+        b0 = TailBatch(
+            last_seqno=5, records=2,
+            touched_users={"a", "b"}, touched_items={"x"},
+            min_event_ms=100, max_event_ms=200,
+        )
+        b1 = TailBatch(
+            last_seqno=9, records=1, set_records=1,
+            touched_users={"b", "c"}, touched_items={"y"},
+            touched_set_types={"item"},
+            min_event_ms=50, max_event_ms=150,
+        )
+        m = merge_batches([b0, b1])
+        assert m.records == 3 and m.set_records == 1
+        assert m.touched_users == {"a", "b", "c"}
+        assert m.touched_items == {"x", "y"}
+        assert m.touched_set_types == {"item"}
+        # the window spans the WIDEST bounds across partitions
+        assert (m.min_event_ms, m.max_event_ms) == (50, 200)
+        # seqno spaces are independent; the merged value is diagnostic max
+        assert m.last_seqno == 9
+        assert m.gap is False
+
+    def test_merge_batches_none_bounds_and_empty(self):
+        from predictionio_tpu.online.follower import TailBatch, merge_batches
+
+        assert merge_batches([]).empty
+        # an all-empty merge stays empty (idle cycle)
+        assert merge_batches([TailBatch(), TailBatch()]).empty
+        # a partition with no interactions contributes no bounds
+        m = merge_batches(
+            [TailBatch(), TailBatch(records=1, min_event_ms=7, max_event_ms=9)]
+        )
+        assert (m.min_event_ms, m.max_event_ms) == (7, 9)
+
+    def test_merge_batches_gap_poisons_the_merge(self):
+        from predictionio_tpu.online.follower import TailBatch, merge_batches
+
+        m = merge_batches([TailBatch(records=3), TailBatch(gap=True)])
+        assert m.gap is True
+        assert not m.empty  # a gap alone forces a resync cycle
+
+
 # ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
@@ -1187,6 +1248,120 @@ class TestRetrainLoopEdges:
         assert any(
             "escuser" in getattr(m, "user_index", {}) for m in loop.models
         )
+
+
+class TestPartitionedLoop:
+    """The retrain loop against a P>1 WAL: one tail + one durable cursor
+    per partition, merged fold-ins, and partition-failure isolation (the
+    'one dead follower' chaos case: siblings advance, the dead partition's
+    window is excluded from the publish, recovery/restart converges)."""
+
+    def _partitioned_loop(self, storage_env, tmp_path, app, partitions=2):
+        from predictionio_tpu.data.wal import PartitionedWal
+        from predictionio_tpu.online.loop import RetrainConfig, RetrainLoop
+
+        variant = _recommendation_variant(storage_env, tmp_path, app=app)
+        # the WAL must exist first: the loop discovers the layout off disk
+        pwal = PartitionedWal(str(tmp_path / "wal"), partitions=partitions)
+        loop = RetrainLoop(
+            variant,
+            RetrainConfig(notify_urls=[], wal_dir=str(tmp_path / "wal")),
+        )
+        return variant, pwal, loop
+
+    def _ingest_routed(self, pwal, le, user, item):
+        """One durable ingest into the partition the user hashes to (the
+        event server's routing rule); returns (partition, seqno)."""
+        from predictionio_tpu.utils.stablehash import stable_bucket
+
+        part = stable_bucket(user, pwal.partitions)
+        return part, _ingest_via_wal(pwal.part(part), le, user, item)
+
+    def _users_covering(self, partitions, prefix="pfresh"):
+        """New user ids, one hashing into EACH partition."""
+        from predictionio_tpu.utils.stablehash import stable_bucket
+
+        found = {}
+        i = 0
+        while len(found) < partitions:
+            user = f"{prefix}-{i}"
+            found.setdefault(stable_bucket(user, partitions), user)
+            i += 1
+        return [found[k] for k in range(partitions)]
+
+    def test_cycle_merges_partitions_and_advances_each_cursor(
+        self, storage_env, tmp_path
+    ):
+        variant, pwal, loop = self._partitioned_loop(
+            storage_env, tmp_path, "PartLoopApp"
+        )
+        assert loop.partitions == 2
+        le = storage_env.get_l_events()
+        u0, u1 = self._users_covering(2)
+        p0, s0 = self._ingest_routed(pwal, le, u0, "i1")
+        p1, s1 = self._ingest_routed(pwal, le, u1, "i2")
+        assert (p0, p1) == (0, 1)
+        assert loop.run_once() == "foldin"
+        # each partition's cursor advanced to ITS seqno space's head
+        assert loop.cursors[0].seqno == s0
+        assert loop.cursors[1].seqno == s1
+        follow = os.path.join(loop.registry.dir, "follow")
+        assert os.path.exists(os.path.join(follow, "cursor-p00000.json"))
+        assert os.path.exists(os.path.join(follow, "cursor-p00001.json"))
+        # ONE merged publish: both partitions' users folded into one model
+        assert loop.registry.latest().source == "foldin"
+        for user in (u0, u1):
+            assert any(
+                user in getattr(m, "user_index", {}) for m in loop.models
+            )
+        assert loop.run_once() == "idle"
+        pwal.close()
+
+    def test_partition_failure_isolated_then_converges(
+        self, storage_env, tmp_path, monkeypatch
+    ):
+        variant, pwal, loop = self._partitioned_loop(
+            storage_env, tmp_path, "PartFailApp"
+        )
+        le = storage_env.get_l_events()
+        u0, u1 = self._users_covering(2, prefix="pkill")
+        _, s0 = self._ingest_routed(pwal, le, u0, "i1")
+        _, s1 = self._ingest_routed(pwal, le, u1, "i2")
+
+        # partition 1's follower "dies" mid-cycle: its sibling still folds
+        # and publishes; the dead partition's cursor holds its window
+        monkeypatch.setenv("PIO_ONLINE_TEST_FAIL_PART", "1")
+        assert loop.run_once() == "foldin"
+        assert loop.cursors[0].seqno == s0
+        assert loop.cursors[1].seqno == 0
+        assert loop.cycles["part_failures"] >= 1
+        generation = loop.registry.latest().version
+        assert any(u0 in getattr(m, "user_index", {}) for m in loop.models)
+        # the dead partition's WINDOW stays excluded from the cycle's
+        # seqno accounting (cursor at 0 above): its records are only in
+        # the publish because the SQL-exact snapshot already flushed them;
+        # change DETECTION for that partition replays on recovery
+
+        # recovery: the held window replays and folds; the cursor catches
+        # up and a newer generation publishes
+        monkeypatch.delenv("PIO_ONLINE_TEST_FAIL_PART")
+        assert loop.run_once() == "foldin"
+        assert loop.cursors[1].seqno == s1
+        assert loop.registry.latest().version > generation
+        assert any(u1 in getattr(m, "user_index", {}) for m in loop.models)
+
+        # a RESTARTED follower (fresh loop, cursors re-read from disk)
+        # agrees the world converged: nothing pending anywhere
+        from predictionio_tpu.online.loop import RetrainConfig, RetrainLoop
+
+        loop2 = RetrainLoop(
+            variant,
+            RetrainConfig(notify_urls=[], wal_dir=str(tmp_path / "wal")),
+        )
+        assert loop2.partitions == 2
+        assert [c.seqno for c in loop2.cursors] == [s0, s1]
+        assert loop2.run_once() == "idle"
+        pwal.close()
 
 
 # ---------------------------------------------------------------------------

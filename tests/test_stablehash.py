@@ -1,0 +1,83 @@
+"""Cross-layer stable-hash pin: serving shard routing and ingest WAL
+partition routing share ONE bytes->bucket definition (utils/stablehash).
+
+The literal values here are the contract. If any of them changes, every
+serving shard map and every partitioned WAL on disk is silently re-keyed:
+scorer shards serve the wrong user rows and ingest replays land events in
+partitions the followers' cursors never cover. Do not "fix" these
+constants to match a new implementation -- fix the implementation.
+"""
+
+import zlib
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.ingest import partition_of
+from predictionio_tpu.serving.shardmap import shard_of
+from predictionio_tpu.utils.stablehash import stable_bucket
+
+#: (key, crc32, {buckets: bucket}) -- computed once, pinned forever
+PINNED = [
+    ("u1", 1112514422, {2: 0, 4: 2, 8: 6, 16: 6}),
+    ("u42", 3733377502, {2: 0, 4: 2, 8: 6, 16: 14}),
+    ("user-7", 2537939745, {2: 1, 4: 1, 8: 1, 16: 1}),
+    ("item::9", 3628038219, {2: 1, 4: 3, 8: 3, 16: 11}),
+    ("Ürsula", 1365438291, {2: 1, 4: 3, 8: 3, 16: 3}),
+    ("42", 841265288, {2: 0, 4: 0, 8: 0, 16: 8}),
+]
+
+
+def _mk_event(entity_id: str) -> Event:
+    return Event.from_json_obj(
+        {"event": "view", "entityType": "user", "entityId": entity_id}
+    )
+
+
+class TestPinnedMapping:
+    def test_exact_bytes_to_bucket_values(self):
+        for key, crc, buckets in PINNED:
+            assert zlib.crc32(key.encode("utf-8")) == crc
+            for n, want in buckets.items():
+                assert stable_bucket(key, n) == want, (key, n)
+
+    def test_definition_is_crc32_of_utf8(self):
+        # the closed-form rule, over a wider spread than the pins
+        for i in range(200):
+            key = f"user-{i}"
+            for n in (2, 3, 4, 7, 8, 16):
+                assert stable_bucket(key, n) == (
+                    zlib.crc32(key.encode("utf-8")) % n
+                )
+
+    def test_degenerate_bucket_counts(self):
+        assert stable_bucket("anything", 1) == 0
+        assert stable_bucket("anything", 0) == 0
+        assert stable_bucket("anything", -3) == 0
+
+    def test_non_string_keys_hash_their_str_form(self):
+        assert stable_bucket(42, 16) == stable_bucket("42", 16) == 8
+
+
+class TestCrossLayerAgreement:
+    """serving/shardmap and data/ingest may never drift apart: a user's
+    factor shard and their events' WAL partition are the same function."""
+
+    def test_shard_of_is_stable_bucket(self):
+        for key, _crc, buckets in PINNED:
+            for n, want in buckets.items():
+                assert shard_of(key, n) == want
+        for i in range(100):
+            for n in (1, 2, 4, 8):
+                assert shard_of(f"u{i}", n) == stable_bucket(f"u{i}", n)
+
+    def test_partition_of_is_stable_bucket_of_entity_id(self):
+        for key, _crc, buckets in PINNED:
+            ev = _mk_event(key)
+            for n, want in buckets.items():
+                assert partition_of(ev, n) == want
+        assert partition_of(_mk_event("u1"), 1) == 0
+
+    def test_serving_shard_equals_ingest_partition_at_equal_counts(self):
+        for i in range(100):
+            ev = _mk_event(f"u{i}")
+            for n in (2, 4, 8):
+                assert partition_of(ev, n) == shard_of(f"u{i}", n)

@@ -1,5 +1,6 @@
 """Write-ahead log unit tests: framing, rotation, checkpoint GC, torn-tail
-and corruption recovery, seqno continuity across restarts."""
+and corruption recovery, seqno continuity across restarts, and the
+partitioned facade's layout resolution (marker vs flat log vs requested)."""
 
 import os
 import struct
@@ -9,8 +10,12 @@ import pytest
 
 from predictionio_tpu.data.wal import (
     FSYNC_POLICIES,
+    PartitionedWal,
     WriteAheadLog,
     _segment_first_seqno,
+    partition_count,
+    partition_dirs,
+    resolve_partitions,
 )
 
 
@@ -195,6 +200,106 @@ def test_segment_name_parse():
     assert _segment_first_seqno("wal-00000000000000000042.log") == 42
     assert _segment_first_seqno("wal.ckpt") is None
     assert _segment_first_seqno("wal-junk.log") is None
+
+
+class TestPartitionedWal:
+    def test_p1_is_byte_compatible_flat_layout(self, tmp_path):
+        """The P=1 degenerate case writes the EXACT pre-partitioning layout:
+        no marker file, segments at the root, readable by a plain
+        WriteAheadLog (old replays keep working on new-code logs)."""
+        d = str(tmp_path)
+        pwal = PartitionedWal(d, partitions=1)
+        assert pwal.partitions == 1
+        assert pwal.part_dirs() == [d]
+        pwal.part(0).append(b"a")
+        pwal.part(0).append(b"b")
+        pwal.part(0).sync()
+        pwal.close()
+        assert not os.path.exists(tmp_path / "wal.parts")
+        assert not any(n.startswith("part-") for n in os.listdir(d))
+        plain = WriteAheadLog(d)
+        assert _records(plain) == [(1, b"a"), (2, b"b")]
+        plain.close()
+
+    def test_partitioned_layout_marker_and_subdirs(self, tmp_path):
+        d = str(tmp_path)
+        pwal = PartitionedWal(d, partitions=4)
+        assert pwal.partitions == 4
+        assert (tmp_path / "wal.parts").exists()
+        assert partition_count(d) == 4
+        dirs = partition_dirs(d)
+        assert dirs == pwal.part_dirs()
+        assert [os.path.basename(p) for p in dirs] == [
+            f"part-{k:05d}" for k in range(4)
+        ]
+        # independent seqno spaces: every partition starts at 1
+        assert [pwal.part(k).append(b"x") for k in range(4)] == [1, 1, 1, 1]
+        for k in range(4):
+            pwal.part(k).sync()
+        pwal.close()
+
+    def test_marker_wins_over_requested_count(self, tmp_path, caplog):
+        """Partition count is fixed at log creation: reopening with a
+        different flag adopts the on-disk layout (with a warning), because
+        splitting/merging live partitions would re-key every seqno space."""
+        d = str(tmp_path)
+        PartitionedWal(d, partitions=4).close()
+        with caplog.at_level("WARNING", logger="pio.wal"):
+            pwal = PartitionedWal(d, partitions=2)
+        assert pwal.partitions == 4
+        assert any("4" in r.message for r in caplog.records)
+        pwal.close()
+
+    def test_existing_flat_log_pins_single_partition(self, tmp_path, caplog):
+        """An old-layout log at the root means P=1 regardless of the flag:
+        partitioning it in place would strand its records outside every
+        partition's replay."""
+        d = str(tmp_path)
+        wal = WriteAheadLog(d)
+        wal.append(b"legacy")
+        wal.sync()
+        wal.close()
+        with caplog.at_level("WARNING", logger="pio.wal"):
+            pwal = PartitionedWal(d, partitions=4)
+        assert pwal.partitions == 1
+        assert not (tmp_path / "wal.parts").exists()
+        assert _records(pwal.part(0)) == [(1, b"legacy")]
+        pwal.close()
+
+    def test_requested_below_one_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            resolve_partitions(str(tmp_path), 0)
+        with pytest.raises(ValueError):
+            PartitionedWal(str(tmp_path), partitions=-1)
+
+    def test_aggregate_counters_sum_partitions(self, tmp_path):
+        pwal = PartitionedWal(str(tmp_path), partitions=3)
+        for k in range(3):
+            for _ in range(k + 1):
+                pwal.part(k).append(b"r")
+            pwal.part(k).sync()
+        assert pwal.append_count == 6
+        assert pwal.fsync_count >= 3
+        assert pwal.pending() == 6
+        pwal.part(0).checkpoint(1)
+        assert pwal.pending() == 5
+        pwal.close()
+
+    def test_reopen_survives_and_replays_per_partition(self, tmp_path):
+        d = str(tmp_path)
+        pwal = PartitionedWal(d, partitions=2)
+        pwal.part(0).append(b"p0")
+        pwal.part(1).append(b"p1a")
+        pwal.part(1).append(b"p1b")
+        for k in range(2):
+            pwal.part(k).sync()
+        pwal.close()
+        # a reader that only knows the directory discovers the layout
+        again = PartitionedWal(d)
+        assert again.partitions == 2
+        assert _records(again.part(0)) == [(1, b"p0")]
+        assert _records(again.part(1)) == [(1, b"p1a"), (2, b"p1b")]
+        again.close()
 
 
 class TestSyncLockDiscipline:
