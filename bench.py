@@ -867,8 +867,9 @@ def secondary_main(result_path: str) -> None:
             # per-family attribution (J = module walks, C = the shared
             # package index is charged to "index" + the C DFS passes,
             # R = flowgraph build + the four leak rules, S = meshflow
-            # build + the five sharding rules): the trend line that
-            # shows WHICH deepening layer starts eating the budget
+            # build + the five sharding rules, P = protocolflow build +
+            # the five cross-process ordering rules): the trend line
+            # that shows WHICH deepening layer starts eating the budget
             "analysis_runtime_seconds_by_family": {
                 fam: round(s, 3)
                 for fam, s in sorted(timings.get("families", {}).items())

@@ -34,10 +34,23 @@ Rule families (catalog with incidents: ``docs/static_analysis.md``;
   (S003), read-after-donate (S004), global placement inside shard_map
   bodies (S005). ``pio check --mesh-report`` renders the same layer as
   the mesh/shard_map/spec site inventory.
+- **P-series** (``rules_protocol``): cross-process protocol ordering on
+  the phase-5 protocolflow layer (``protocols``): a declared table of
+  each protocol's commit/publication/advance points, classified per call
+  site and credited transitively over the call graph, plus per-module
+  ``__main__`` process roles stitched through ring/portfile/notify
+  edges. Ack reachable before its covering commit (P001), cursor
+  advance before the consumer obligation completes (P002), unguarded
+  cross-process version reads (P003), shard/partition moduli bypassing
+  ``utils/stablehash`` (P004), handshake renames without covering fsync
+  and READY files consumed without CRC verify (P005).
+  ``pio check --protocol-report`` renders the same layer as the
+  commit/publish/advance site inventory.
 
 ``analysis/baseline.json`` suppresses accepted findings (with mandatory
-justifications); the tier-1 gate in ``tests/test_analysis.py`` asserts
-zero unsuppressed findings over the package. ``analysis/lockwatch.py``
+justifications; P entries additionally name the runtime test covering
+the accepted risk); the tier-1 gate in ``tests/test_analysis.py``
+asserts zero unsuppressed findings over the package. ``analysis/lockwatch.py``
 and ``analysis/leakwatch.py`` are the runtime companions: lockwatch
 validates C001 against actual acquisition orders under pytest and
 records held locksets for C006's evidence; leakwatch watches span

@@ -8,13 +8,25 @@ from typing import Iterator
 
 def dotted(node: ast.AST) -> str | None:
     """``jax.experimental.shard_map`` for a Name/Attribute chain, else None."""
-    parts: list[str] = []
+    # bare Name and one-level Attribute cover most call sites; this runs
+    # hundreds of thousands of times per sweep, so skip the list+join
+    # machinery for them
+    if isinstance(node, ast.Name):
+        return node.id
+    if not isinstance(node, ast.Attribute):
+        return None
+    value = node.value
+    if isinstance(value, ast.Name):
+        return f"{value.id}.{node.attr}"
+    parts: list[str] = [node.attr]
+    node = value
     while isinstance(node, ast.Attribute):
         parts.append(node.attr)
         node = node.value
     if isinstance(node, ast.Name):
         parts.append(node.id)
-        return ".".join(reversed(parts))
+        parts.reverse()
+        return ".".join(parts)
     return None
 
 

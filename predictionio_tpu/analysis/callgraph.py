@@ -75,10 +75,14 @@ class FunctionInfo:
         return self.qual.rsplit(".", 1)[-1]
 
     def params(self) -> list[str]:
-        a = getattr(self.node, "args", None)
-        if a is None:
-            return []
-        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        cached = self.__dict__.get("_params")
+        if cached is None:
+            a = getattr(self.node, "args", None)
+            cached = [] if a is None else [
+                p.arg for p in a.posonlyargs + a.args + a.kwonlyargs
+            ]
+            self.__dict__["_params"] = cached
+        return cached
 
 
 @dataclass(eq=False)
@@ -160,6 +164,8 @@ class CallGraph:
         #: (fkey, param) -> set[FunctionInfo]: higher-order bindings
         self.param_bindings: dict[tuple, set] = {}
         self._local_env_cache: dict[tuple, dict] = {}
+        self._returned_defs_cache: dict[tuple, list] = {}
+        self._params_cache: dict[tuple, frozenset] = {}
         #: id(fn node) -> flattened body-node list; every layer built on
         #: the graph (edges, roles, locksets fast path, the R-series
         #: flowgraphs) re-reads this instead of re-walking the AST --
@@ -181,84 +187,113 @@ class CallGraph:
         # nobody -- the fill below is what makes body_nodes() free
         dead: list = []
 
-        def enter_function(child, fq, owner):
-            """Recurse into a def/lambda, filling its body-node cache
-            inline: body statements (and their subtrees) go to the
-            function's list, decorators/args are indexed but -- like
-            ``_body_walk`` -- belong to no body."""
+        # iterative pre-order walk with an explicit stack; this touches
+        # every node of every module, so generator machinery per node
+        # (ast.iter_child_nodes) is what the inlined child iteration
+        # below buys back. Stack entries carry the walk context:
+        # (node, qual, parent_cls -- class the node is a DIRECT child
+        # of, encl_cls -- innermost lexically-enclosing class, body --
+        # innermost function's flattened node list)
+        AST = ast.AST
+        ATOM = _ATOM
+
+        def push_children(stack, node, qual, parent_cls, encl_cls, body):
+            sub = []
+            append = sub.append
+            for name in node._fields:
+                f = getattr(node, name, None)
+                if isinstance(f, AST):
+                    if not isinstance(f, ATOM):
+                        append((f, qual, parent_cls, encl_cls, body))
+                elif type(f) is list:
+                    for item in f:
+                        if isinstance(item, AST) and not isinstance(item, ATOM):
+                            append((item, qual, parent_cls, encl_cls, body))
+            sub.reverse()
+            stack.extend(sub)
+
+        def enter_function(stack, child, fq, owner, stmts):
+            """Descend into a def/lambda, filling its body-node cache:
+            body statements (and their subtrees) go to the function's
+            list, decorators/args are indexed but -- like ``_body_walk``
+            -- belong to no body. Nested defs and lambdas inside a
+            method close over its self, so they resolve self.* against
+            the class (owner as encl_cls) even though only direct
+            children are METHODS (parent_cls=None below)."""
             fbody: list = []
             self._body_cache[id(child)] = fbody
-            stmts = child.body if isinstance(child.body, list) else [child.body]
             body_ids = {id(s) for s in stmts}
-            for sub in ast.iter_child_nodes(child):
-                visit([sub], fq, None, owner,
-                      fbody if id(sub) in body_ids else dead)
+            sub = []
+            for name in child._fields:
+                f = getattr(child, name, None)
+                if isinstance(f, AST):
+                    if not isinstance(f, ATOM):
+                        sub.append((f, fq, None, owner,
+                                    fbody if id(f) in body_ids else dead))
+                elif type(f) is list:
+                    for item in f:
+                        if isinstance(item, AST) and not isinstance(item, ATOM):
+                            sub.append((item, fq, None, owner,
+                                        fbody if id(item) in body_ids
+                                        else dead))
+            sub.reverse()
+            stack.extend(sub)
 
-        def visit(
-            children, qual: str,
-            parent_cls: ClassInfo | None,   # class this is a DIRECT child of
-            encl_cls: ClassInfo | None,     # innermost lexically-enclosing class
-            body: list,                     # innermost function's node list
-        ):
-            for child in children:
-                if isinstance(child, ast.ClassDef):
-                    cq = f"{qual}.{child.name}" if qual else child.name
-                    cinfo = ClassInfo(mod.path, cq, child, module=mod)
-                    mod.classes[cq] = cinfo
-                    self.classes[cinfo.key] = cinfo
-                    body.append(child)
-                    visit(ast.iter_child_nodes(child), cq, cinfo, cinfo, body)
-                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    fq = f"{qual}.{child.name}" if qual else child.name
-                    owner = parent_cls or encl_cls
-                    # nested defs and lambdas inside a method close over
-                    # its self, so they resolve self.* against the class
-                    # even though only direct children are METHODS
-                    info = FunctionInfo(
-                        mod.path, fq, child,
-                        cls=owner.qual if owner else None,
-                        module=mod,
-                    )
-                    mod.funcs[fq] = info
-                    self.functions[info.key] = info
-                    if parent_cls is not None:
-                        parent_cls.methods[child.name] = info
-                    elif not qual:
-                        mod.top[child.name] = info
-                    enter_function(child, fq, owner)
-                elif isinstance(child, ast.Lambda):
-                    fq = f"{qual}.<lambda:{child.lineno}>" if qual else (
-                        f"<lambda:{child.lineno}>"
-                    )
-                    owner = parent_cls or encl_cls
-                    info = FunctionInfo(
-                        mod.path, fq, child,
-                        cls=owner.qual if owner else None,
-                        module=mod,
-                    )
-                    mod.funcs[fq] = info
-                    self.functions[info.key] = info
-                    enter_function(child, fq, owner)
-                else:
-                    if isinstance(child, (ast.Import, ast.ImportFrom)):
-                        mod.import_nodes.append(child)
-                    elif isinstance(child, ast.Assign) and isinstance(
-                        child.value, ast.Call
-                    ):
+        stack: list = []
+        push_children(stack, ctx.tree, "", None, None, dead)
+        while stack:
+            child, qual, parent_cls, encl_cls, body = stack.pop()
+            t = child.__class__
+            if t is ast.ClassDef:
+                cq = f"{qual}.{child.name}" if qual else child.name
+                cinfo = ClassInfo(mod.path, cq, child, module=mod)
+                mod.classes[cq] = cinfo
+                self.classes[cinfo.key] = cinfo
+                body.append(child)
+                push_children(stack, child, cq, cinfo, cinfo, body)
+            elif t is ast.FunctionDef or t is ast.AsyncFunctionDef:
+                fq = f"{qual}.{child.name}" if qual else child.name
+                owner = parent_cls or encl_cls
+                info = FunctionInfo(
+                    mod.path, fq, child,
+                    cls=owner.qual if owner else None,
+                    module=mod,
+                )
+                mod.funcs[fq] = info
+                self.functions[info.key] = info
+                if parent_cls is not None:
+                    parent_cls.methods[child.name] = info
+                elif not qual:
+                    mod.top[child.name] = info
+                enter_function(stack, child, fq, owner, child.body)
+            elif t is ast.Lambda:
+                fq = f"{qual}.<lambda:{child.lineno}>" if qual else (
+                    f"<lambda:{child.lineno}>"
+                )
+                owner = parent_cls or encl_cls
+                info = FunctionInfo(
+                    mod.path, fq, child,
+                    cls=owner.qual if owner else None,
+                    module=mod,
+                )
+                mod.funcs[fq] = info
+                self.functions[info.key] = info
+                enter_function(stack, child, fq, owner, [child.body])
+            else:
+                if t is ast.Import or t is ast.ImportFrom:
+                    mod.import_nodes.append(child)
+                elif t is ast.Assign:
+                    if isinstance(child.value, ast.Call):
                         mod.call_assigns.append(child)
-                    elif (
-                        isinstance(child, ast.If)
-                        and qual == ""
-                        and _is_main_guard(child.test)
-                    ):
-                        mod.main_body.extend(child.body)
-                    body.append(child)
-                    visit(
-                        ast.iter_child_nodes(child), qual, parent_cls,
-                        encl_cls, body,
-                    )
-
-        visit(ast.iter_child_nodes(ctx.tree), "", None, None, dead)
+                elif (t is ast.If and qual == ""
+                        and _is_main_guard(child.test)):
+                    mod.main_body.extend(child.body)
+                body.append(child)
+                if t is ast.Name or t is ast.Constant:
+                    continue  # leaves: nothing left to push
+                push_children(
+                    stack, child, qual, parent_cls, encl_cls, body
+                )
 
     def _index_imports(self) -> None:
         for mod in self.modules.values():
@@ -516,6 +551,13 @@ class CallGraph:
             return [m] if m else []
         return []
 
+    def _params_set(self, fi: FunctionInfo) -> frozenset:
+        cached = self._params_cache.get(fi.key)
+        if cached is None:
+            cached = frozenset(fi.params())
+            self._params_cache[fi.key] = cached
+        return cached
+
     def _method_anywhere(self, mod: ModuleInfo, name: str) -> list:
         """``self.X`` with no same-class hit: any unique method named X in
         the module (the phase-1 _LockIndex heuristic, kept for fixtures
@@ -526,6 +568,9 @@ class CallGraph:
         return hits if len(hits) == 1 else []
 
     def _returned_defs(self, factory: FunctionInfo) -> list:
+        cached = self._returned_defs_cache.get(factory.key)
+        if cached is not None:
+            return cached
         out = []
         for ret in ast.walk(factory.node):
             if isinstance(ret, ast.Return) and isinstance(ret.value, ast.Name):
@@ -534,6 +579,7 @@ class CallGraph:
                 )
                 if nested is not None:
                     out.append(nested)
+        self._returned_defs_cache[factory.key] = out
         return out
 
     # -- call resolution ----------------------------------------------------
@@ -545,7 +591,7 @@ class CallGraph:
             # (lambda ...)(...) and subscripted callees: skip
             return []
         # param(...) through higher-order bindings
-        if "." not in d and d in set(fi.params()):
+        if "." not in d and d in self._params_set(fi):
             return sorted(
                 self.param_bindings.get((fi.key, d), ()),
                 key=lambda f: f.key,
@@ -574,7 +620,7 @@ class CallGraph:
         # majority of sites never needs a second look
         dynamic: list[tuple] = []   # (fi, CallSite)
         for fi in list(self.functions.values()):
-            params = set(fi.params())
+            params = self._params_set(fi)
             sites: list[CallSite] = []
             for node in self.body_nodes(fi.node):
                 if not isinstance(node, ast.Call):
@@ -710,6 +756,13 @@ def _parse_annotation(text: str) -> ast.AST | None:
         return None
 
 
+#: context/operator singletons (Load, Store, Add, Eq, ...): no children,
+#: never inspected as standalone nodes (rules read them as ``node.ctx`` /
+#: ``node.op`` attributes) -- ~a third of all AST nodes, so both the index
+#: walk and every body_nodes() consumer skip them
+_ATOM = (ast.expr_context, ast.boolop, ast.operator, ast.unaryop, ast.cmpop)
+
+
 def _body_walk(fn: ast.AST):
     """Walk a function body without descending into nested defs/lambdas
     (those are their own call-graph nodes)."""
@@ -720,7 +773,8 @@ def _body_walk(fn: ast.AST):
         yield node
         for child in ast.iter_child_nodes(node):
             if isinstance(
-                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda) + _ATOM,
             ):
                 continue
             stack.append(child)

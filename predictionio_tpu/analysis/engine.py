@@ -120,22 +120,43 @@ def iter_py_files(root: str) -> Iterator[str]:
                 yield os.path.join(dirpath, name)
 
 
+#: (abspath, root) -> (mtime_ns, size, ModuleContext). Repeated
+#: in-process checks (check + report in one invocation, self_check, the
+#: fixture suite) re-parse an unchanged file for free; any on-disk edit
+#: changes the stat signature and invalidates the entry.
+_parse_cache: dict = {}
+
+
 def parse_module(path: str, root: str | None = None) -> ModuleContext | None:
     root = root or repo_root()
+    apath = os.path.abspath(path)
     try:
-        with open(path, "r", encoding="utf-8") as f:
-            source = f.read()
+        st = os.stat(apath)
     except OSError:
         # a path that vanished between scoping and parsing (a deleted
         # file in the --changed diff, a mid-run unlink) is skipped like
         # a syntax error, never a crash
         return None
-    rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    key = (apath, root)
+    hit = _parse_cache.get(key)
+    if (hit is not None and hit[0] == st.st_mtime_ns
+            and hit[1] == st.st_size):
+        return hit[2]
+    try:
+        with open(apath, "r", encoding="utf-8") as f:
+            source = f.read()
+    except OSError:
+        return None
+    rel = os.path.relpath(apath, root).replace(os.sep, "/")
     try:
         tree = ast.parse(source, filename=rel)
     except SyntaxError:
         return None
-    return ModuleContext(path=rel, tree=tree, source=source)
+    ctx = ModuleContext(path=rel, tree=tree, source=source)
+    # the signature was taken before the read: if the file changed in
+    # between, the stale entry misses on the next stat and re-parses
+    _parse_cache[key] = (st.st_mtime_ns, st.st_size, ctx)
+    return ctx
 
 
 def parse_source(source: str, path: str = "fixture.py") -> ModuleContext:
@@ -148,6 +169,7 @@ def all_rules() -> list:
     from predictionio_tpu.analysis import (
         rules_concurrency,
         rules_jax,
+        rules_protocol,
         rules_resources,
         rules_sharding,
     )
@@ -155,7 +177,7 @@ def all_rules() -> list:
     return [
         cls() for cls in (
             rules_jax.RULES + rules_concurrency.RULES + rules_resources.RULES
-            + rules_sharding.RULES
+            + rules_sharding.RULES + rules_protocol.RULES
         )
     ]
 
@@ -525,6 +547,91 @@ def render_sarif(
     return json.dumps(doc, indent=2)
 
 
+# -- inventory reports (shared by --mesh-report and --protocol-report) --------
+
+def render_site_report_text(name: str, sites: list[dict]) -> str:
+    """The shared inventory renderer: sites grouped by file plus a
+    one-line kind summary. ``--mesh-report`` and ``--protocol-report``
+    both route here, so the two reports cannot drift in format."""
+    lines: list = []
+    counts: dict = {}
+    by_path: dict = {}
+    for site in sites:
+        counts[site["kind"]] = counts.get(site["kind"], 0) + 1
+        by_path.setdefault(site["path"], []).append(site)
+    for path in sorted(by_path):
+        lines.append(f"{path}:")
+        for site in by_path[path]:
+            lines.append(
+                f"  {site['line']}: [{site['kind']}] {site['qual']}: "
+                f"{site['detail']}"
+            )
+    lines.append("")
+    lines.append(
+        f"{name}: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+        + f" ({len(sites)} sites)"
+    )
+    return "\n".join(lines)
+
+
+def render_site_report_json(name: str, sites: list[dict]) -> str:
+    counts: dict = {}
+    for site in sites:
+        counts[site["kind"]] = counts.get(site["kind"], 0) + 1
+    return json.dumps({
+        "sites": sites,
+        "counts": dict(sorted(counts.items())),
+        "total": len(sites),
+    }, indent=2)
+
+
+def render_site_report_sarif(name: str, sites: list[dict]) -> str:
+    """Inventory sites as note-level SARIF results (one ruleId per site
+    kind) so CI annotators ingest the reports through the same pipeline
+    as rule findings; round-trips against the json format (same site
+    count, same locations)."""
+    kinds = sorted({s["kind"] for s in sites})
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "pio-check",
+                    "informationUri": (
+                        "https://github.com/apache/predictionio"
+                    ),
+                    "rules": [
+                        {
+                            "id": f"{name}/{kind}",
+                            "shortDescription": {
+                                "text": f"{name} inventory site: {kind}"
+                            },
+                            "defaultConfiguration": {"level": "note"},
+                        }
+                        for kind in kinds
+                    ],
+                },
+            },
+            "results": [
+                {
+                    "ruleId": f"{name}/{s['kind']}",
+                    "level": "note",
+                    "message": {
+                        "text": f"{s['qual']}: {s['detail']}"
+                    },
+                    "locations": [
+                        _sarif_location(s["path"], s["line"])
+                    ],
+                }
+                for s in sites
+            ],
+        }],
+    }
+    return json.dumps(doc, indent=2)
+
+
 def self_check(baseline_path: str | None = None) -> list[str]:
     """Cheap integrity pass: rules compile and are well-formed, every
     baseline entry still matches a real finding and carries a real
@@ -575,7 +682,7 @@ DOCS_TABLE_BEGIN = "<!-- BEGIN GENERATED RULE TABLE: {family} (pio check --updat
 DOCS_TABLE_END = "<!-- END GENERATED RULE TABLE: {family} -->"
 
 #: every docstring-generated rule family, in docs order
-DOC_FAMILIES = ("J", "C", "R", "S")
+DOC_FAMILIES = ("J", "C", "R", "S", "P")
 
 
 def _split_doc(rule) -> tuple[str, str]:
@@ -703,9 +810,17 @@ def add_check_arguments(parser) -> None:
     parser.add_argument(
         "--mesh-report", action="store_true",
         help="emit the inventory of mesh/shard_map/PartitionSpec/"
-        "NamedSharding/sharded-jit construction sites (text or --format "
-        "json) instead of running the rules -- the MPMD executor-"
-        "extraction worklist",
+        "NamedSharding/sharded-jit construction sites (text, json, or "
+        "sarif via --format) instead of running the rules -- the MPMD "
+        "executor-extraction worklist",
+    )
+    parser.add_argument(
+        "--protocol-report", action="store_true",
+        help="emit the inventory of declared cross-process protocol "
+        "points -- every commit (fsync/rename), publication (ring push, "
+        "registry publish, notify, ack), and cursor-advance site with "
+        "its protocol (text, json, or sarif via --format) instead of "
+        "running the rules",
     )
     parser.add_argument(
         "--rules", default=None,
@@ -781,19 +896,16 @@ def run_with_args(args) -> int:
             f"docs rule table(s) regenerated: {', '.join(replaced)}-series"
         )
         return 0
-    if getattr(args, "mesh_report", False):
-        if args.format == "sarif":
-            print("Error: --mesh-report renders text or json, not sarif")
-            return 2
+    wants_mesh = getattr(args, "mesh_report", False)
+    wants_protocol = getattr(args, "protocol_report", False)
+    if wants_mesh and wants_protocol:
+        print("Error: --mesh-report and --protocol-report are exclusive")
+        return 2
+    if wants_mesh or wants_protocol:
         missing = [p for p in args.paths if not os.path.exists(p)]
         if missing:
             print(f"Error: no such file or directory: {', '.join(missing)}")
             return 2
-        from predictionio_tpu.analysis.meshflow import (
-            MeshFlow,
-            render_mesh_report_json,
-            render_mesh_report_text,
-        )
         from predictionio_tpu.analysis.packageindex import PackageIndex
 
         root = repo_root()
@@ -803,11 +915,19 @@ def run_with_args(args) -> int:
                 files.extend(iter_py_files(p))
             else:
                 files.append(p)
-        flow = MeshFlow(PackageIndex.build(parse_files(files, root)))
-        if args.format == "json":
-            print(render_mesh_report_json(flow))
+        index = PackageIndex.build(parse_files(files, root))
+        if wants_mesh:
+            name, sites = "mesh-report", index.meshflow().report_sites()
         else:
-            print(render_mesh_report_text(flow))
+            name, sites = (
+                "protocol-report", index.protocols().report_sites()
+            )
+        if args.format == "json":
+            print(render_site_report_json(name, sites))
+        elif args.format == "sarif":
+            print(render_site_report_sarif(name, sites))
+        else:
+            print(render_site_report_text(name, sites))
         return 0
     if args.self_check:
         problems = self_check(

@@ -213,19 +213,15 @@ class LockModel:
             if cinfo is not None:
                 method_names = set(cinfo.methods)
         nodes = self.graph.body_nodes(fi.node)
-        if not any(
-            isinstance(n, (ast.With, ast.AsyncWith))
-            or (
-                isinstance(n, ast.Call)
-                and isinstance(n.func, ast.Attribute)
-                and n.func.attr == "acquire"
-            )
-            for n in nodes
-        ):
-            # lock-free function (the overwhelming majority): every held
-            # set is empty, so the facts fall straight out of the cached
-            # flat body list -- no region recursion
-            return self._walk_flat(fi, facts, method_names, nodes)
+        # lock-free function (the overwhelming majority): every held set
+        # is empty, so the facts fall straight out of the cached flat
+        # body list -- no region recursion. The flat walk itself detects
+        # With/acquire nodes and bails (returns None) so the common case
+        # pays a single pass instead of prescan + walk.
+        flat = self._walk_flat(fi, facts, method_names, nodes)
+        if flat is not None:
+            return flat
+        facts = FuncFacts(fi)
 
         def visit(node: ast.AST, held: tuple) -> None:
             if isinstance(node, (ast.With, ast.AsyncWith)):
@@ -325,12 +321,19 @@ class LockModel:
 
     def _walk_flat(
         self, fi: FunctionInfo, facts: FuncFacts, method_names: set, nodes
-    ) -> FuncFacts:
+    ) -> "FuncFacts | None":
         """The no-locks fast path: identical facts to the region walk,
-        with every held set the empty frozenset."""
+        with every held set the empty frozenset. Returns None on the
+        first With/acquire node -- the caller restarts with the region
+        walk (partial facts are discarded with the FuncFacts)."""
         held = self._EMPTY
         for node in nodes:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                return None
             if isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "acquire"):
+                    return None
                 reason = blocking_reason(node)
                 if reason is not None:
                     facts.blocking.append((reason, held, node.lineno, node))

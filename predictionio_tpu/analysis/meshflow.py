@@ -57,7 +57,6 @@ shared MPMD executor layer.
 from __future__ import annotations
 
 import ast
-import json
 from dataclasses import dataclass, field
 
 from predictionio_tpu.analysis.astutil import call_name, dotted, keyword
@@ -208,6 +207,9 @@ class MeshFlow:
         #: (FunctionInfo, NamedSharding ast.Call) pairs, recorded during
         #: the ONE site scan so S002 never re-walks the package
         self.named_sharding_calls: list = []
+        #: id(ctx) -> [(node, qual)]: the module-level walk runs once per
+        #: module, not once per pass that needs it
+        self._mod_nodes_cache: dict = {}
         #: fkeys of functions that run under jit (jit(f)/pjit(f) call
         #: sites resolved through the graph -- factory forms included --
         #: plus @jit-style decorators); found during the ONE site scan,
@@ -327,10 +329,17 @@ class MeshFlow:
     # -- environments ---------------------------------------------------------
     def _module_level_nodes(self, ctx):
         """Module statements outside any def/lambda (class bodies kept:
-        class-level spec constants are real mint sites). Yields
-        ``(node, qual)`` with the enclosing-class qualname computed
+        class-level spec constants are real mint sites). Returns
+        ``(node, qual)`` pairs with the enclosing-class qualname computed
         inline -- never ``ctx.symbol_for``, whose lazy full-module symbol
         map is exactly the cost the pre-commit budget cannot pay."""
+        cached = self._mod_nodes_cache.get(id(ctx))
+        if cached is None:
+            cached = list(self._walk_module_level(ctx))
+            self._mod_nodes_cache[id(ctx)] = cached
+        return cached
+
+    def _walk_module_level(self, ctx):
         stack = [(n, "<module>") for n in ast.iter_child_nodes(ctx.tree)]
         while stack:
             node, qual = stack.pop()
@@ -903,46 +912,16 @@ class MeshFlow:
             out.extend(v for v in vals if isinstance(v, MeshVal))
         return out
 
-
-# -- mesh-report rendering ----------------------------------------------------
-
-def render_mesh_report_text(flow: MeshFlow) -> str:
-    """The ``--mesh-report`` inventory: every mesh / PartitionSpec /
-    NamedSharding / shard_map / sharded-jit construction site, grouped by
-    file -- the worklist for extracting the shared MPMD executor layer."""
-    lines: list = []
-    counts: dict = {}
-    by_path: dict = {}
-    for site in flow.sites:
-        counts[site.kind] = counts.get(site.kind, 0) + 1
-        by_path.setdefault(site.path, []).append(site)
-    for path in sorted(by_path):
-        lines.append(f"{path}:")
-        for site in by_path[path]:
-            lines.append(
-                f"  {site.line}: [{site.kind}] {site.qual}: {site.detail}"
-            )
-    lines.append("")
-    lines.append(
-        "mesh-report: "
-        + ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
-        + f" ({len(flow.sites)} sites)"
-    )
-    return "\n".join(lines)
-
-
-def render_mesh_report_json(flow: MeshFlow) -> str:
-    counts: dict = {}
-    for site in flow.sites:
-        counts[site.kind] = counts.get(site.kind, 0) + 1
-    return json.dumps({
-        "sites": [
+    def report_sites(self) -> list:
+        """The ``--mesh-report`` inventory as uniform site dicts for the
+        shared report writer (``engine.render_site_report_*``): every
+        mesh / PartitionSpec / NamedSharding / shard_map / sharded-jit
+        construction site -- the worklist for extracting the shared MPMD
+        executor layer."""
+        return [
             {
                 "kind": s.kind, "path": s.path, "qual": s.qual,
                 "line": s.line, "detail": s.detail,
             }
-            for s in flow.sites
-        ],
-        "counts": dict(sorted(counts.items())),
-        "total": len(flow.sites),
-    }, indent=2)
+            for s in self.sites
+        ]

@@ -34,16 +34,35 @@ class PackageIndex:
     #: lazily-built sharding-facts layer (meshflow); non-S runs never
     #: pay for it
     _meshflow: object = None
+    #: lazily-built cross-process protocol layer; non-P runs never pay
+    #: for it
+    _protocols: object = None
+
+    #: single-entry memo: (context identity tuple, pinned context list,
+    #: index). ``parse_module`` returns the SAME ModuleContext object for
+    #: an unchanged file, so an identical identity tuple proves the trees
+    #: are identical and the previous build (plus its lazy layers) can be
+    #: reused -- the check+report flows and the fixture suite build the
+    #: same index back to back. The pinned list keeps the contexts alive
+    #: so their ids cannot be recycled while the memo holds them.
+    _build_memo = None
 
     @classmethod
     def build(cls, contexts: list) -> "PackageIndex":
+        contexts = list(contexts)
+        key = tuple(map(id, contexts))
+        memo = cls._build_memo
+        if memo is not None and memo[0] == key:
+            return memo[2]
         graph = CallGraph(contexts)
-        return cls(
+        index = cls(
             contexts=contexts,
             graph=graph,
             roles=RoleInference(graph),
             locks=LockModel(graph),
         )
+        cls._build_memo = (key, contexts, index)
+        return index
 
     def resources(self):
         """The shared :class:`~predictionio_tpu.analysis.flowgraph.
@@ -66,6 +85,18 @@ class PackageIndex:
 
             self._meshflow = MeshFlow(self)
         return self._meshflow
+
+    def protocols(self):
+        """The shared :class:`~predictionio_tpu.analysis.protocols.
+        ProtocolFlow`: declared commit/publish/advance points classified
+        over the call graph + process roles, built ONCE per index and
+        cached (every P rule and ``--protocol-report`` read the same
+        build)."""
+        if self._protocols is None:
+            from predictionio_tpu.analysis.protocols import ProtocolFlow
+
+            self._protocols = ProtocolFlow(self)
+        return self._protocols
 
 
 class PackageRule:

@@ -74,9 +74,11 @@ class StorageClient(base.BaseStorageClient):
 
 
 def shard_of(entity_type: str, entity_id: str) -> int:
-    import zlib
+    from predictionio_tpu.utils.stablehash import stable_bucket
 
-    return zlib.crc32(f"{entity_type}\x00{entity_id}".encode()) % N_SHARDS
+    # same crc32-of-utf8 bytes as the old inline modulus, so existing
+    # rowkeys keep their shard prefix
+    return stable_bucket(f"{entity_type}\x00{entity_id}", N_SHARDS)
 
 
 def make_rowkey(event: Event, suffix: str | None = None) -> str:

@@ -79,6 +79,14 @@ def _part_dir_name(index: int) -> str:
     return f"{_PART_DIR_PREFIX}{index:05d}"
 
 
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def _segment_name(first_seqno: int) -> str:
     return f"{_SEGMENT_PREFIX}{first_seqno:020d}{_SEGMENT_SUFFIX}"
 
@@ -538,6 +546,10 @@ class PartitionedWal:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        # the marker is the layout's source of truth: without a directory
+        # fsync the new entry itself can vanish at a power cut, and a
+        # restarted reader would resolve a different partition count
+        _fsync_dir(self.directory)
 
     def part(self, index: int) -> WriteAheadLog:
         return self.parts[index]

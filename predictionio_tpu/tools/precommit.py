@@ -3,9 +3,10 @@
 ``python -m predictionio_tpu.tools.precommit`` runs
 ``pio check --changed --format text`` -- the report scoped to files git
 says changed vs HEAD, per-module rules run only on those files, the
-interprocedural J/C/R analyses still see the whole package (a leak in a
-changed file whose release lives two modules away is exactly what the
-call-graph credit exists for). The run is budgeted at < 2 s on a
+interprocedural J/C/R/S/P analyses still see the whole package (a leak
+in a changed file whose release lives two modules away, or an ack whose
+covering commit lives in a callee, is exactly what the call-graph
+credit exists for). The run is budgeted at < 2 s on a
 one-file diff (test-asserted in ``tests/test_analysis.py``), so it sits
 comfortably inside a commit hook.
 
